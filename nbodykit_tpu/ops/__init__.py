@@ -1,0 +1,10 @@
+"""Compute kernels: particle-mesh windows, painting/readout, white noise,
+FFTLog, and special functions — the layer replacing the reference's C
+extension kernels (pmesh C paint, kdcount, Corrfunc; SURVEY.md §2.3)."""
+
+from .window import (RESAMPLERS, window_support, window_weights,
+                     compensation_transfer)
+from .paint import paint_local, readout_local
+
+__all__ = ['RESAMPLERS', 'window_support', 'window_weights',
+           'compensation_transfer', 'paint_local', 'readout_local']

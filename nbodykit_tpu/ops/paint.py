@@ -1,0 +1,132 @@
+"""Local paint (scatter-add) and readout (gather) kernels.
+
+These are the per-device primitives replacing pmesh's C paint/readout
+(consumed by the reference at nbodykit/source/mesh/catalog.py:287-296 and
+nbodykit/algorithms/fftrecon.py:217-268). They operate on a *local* mesh
+block — the full mesh on a single device, or a halo-extended slab inside
+``shard_map`` for the distributed path (see pmesh_tpu.ParticleMesh.paint).
+
+Positions arrive in *cell units*. Indices are wrapped periodically modulo
+``period`` (the global mesh size per axis) and then offset into the local
+block; the offset+halo bookkeeping is the caller's job.
+
+The scatter-add is chunked over particles (``chunk``) to bound the memory
+of the (n, s^3) weight expansion, using lax.fori_loop so one compiled
+program handles any particle count.
+"""
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+from .window import window_weights, window_support
+
+
+def _neighbor_products(pos, resampler, period, origin):
+    """(n, s, 3) wrapped local indices and (n, s) per-axis weights."""
+    idx = []
+    wts = []
+    for ax in range(3):
+        i, w = window_weights(pos[:, ax], resampler)
+        i = jnp.mod(i, period[ax])
+        if ax == 0:
+            i = jnp.mod(i - origin, period[ax])
+        idx.append(i)
+        wts.append(w)
+    return idx, wts
+
+
+def paint_local(pos, mass, shape, resampler='cic', period=None, origin=0,
+                out=None, chunk=None):
+    """Scatter particles onto a local mesh block.
+
+    Parameters
+    ----------
+    pos : (n, 3) float — positions in global cell units
+    mass : (n,) float or scalar — the value to deposit (0 masks a slot)
+    shape : (n0l, N1, N2) — local block shape
+    period : (3,) int — global mesh size for periodic wrapping; defaults
+        to ``shape`` (single-device case)
+    origin : int — global row index of the local block's first row
+        (after periodic wrap; halo-extended blocks pass d*n0 - h)
+    out : optional existing block to accumulate into (hold=True semantics)
+    chunk : particles per scatter pass (default: all at once)
+
+    Returns
+    -------
+    (n0l, N1, N2) block with sum of mass*window deposited.
+    """
+    n0l, N1, N2 = shape
+    if period is None:
+        period = shape
+    period = tuple(int(p) for p in period)
+    s = window_support(resampler)
+    n = pos.shape[0]
+    dtype = out.dtype if out is not None else (
+        mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
+    flat = jnp.zeros(n0l * N1 * N2, dtype=dtype) if out is None \
+        else out.reshape(-1)
+
+    mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
+
+    def body(pos_c, mass_c, flat):
+        idx, wts = _neighbor_products(pos_c, resampler, period, origin)
+        # tensor-product expansion: (nc, s, s, s)
+        i0, i1, i2 = idx
+        w0, w1, w2 = wts
+        lin = ((i0[:, :, None, None] * N1 + i1[:, None, :, None]) * N2
+               + i2[:, None, None, :])
+        w = (w0[:, :, None, None] * w1[:, None, :, None]
+             * w2[:, None, None, :]).astype(dtype)
+        w = w * mass_c[:, None, None, None]
+        # rows outside the local block get clamped weight-0 writes
+        valid = (i0[:, :, None, None] >= 0) & (i0[:, :, None, None] < n0l)
+        lin = jnp.where(valid, lin, 0)
+        w = jnp.where(valid, w, 0)
+        return flat.at[lin.reshape(-1)].add(w.reshape(-1))
+
+    if chunk is None or chunk >= n:
+        flat = body(pos, mass, flat)
+    else:
+        nchunks = (n + chunk - 1) // chunk
+        npad = nchunks * chunk
+        pos_p = jnp.concatenate(
+            [pos, jnp.zeros((npad - n, 3), pos.dtype)], axis=0)
+        mass_p = jnp.concatenate(
+            [mass, jnp.zeros((npad - n,), dtype)], axis=0)
+        pos_p = pos_p.reshape(nchunks, chunk, 3)
+        mass_p = mass_p.reshape(nchunks, chunk)
+
+        def loop(i, flat):
+            return body(pos_p[i], mass_p[i], flat)
+        flat = jax.lax.fori_loop(0, nchunks, loop, flat)
+
+    return flat.reshape(shape)
+
+
+def readout_local(block, pos, resampler='cic', period=None, origin=0):
+    """Interpolate a local mesh block at particle positions (gather).
+
+    Parameters mirror :func:`paint_local`; out-of-block rows contribute 0.
+
+    Returns
+    -------
+    (n,) values of the window-weighted interpolation.
+    """
+    shape = block.shape
+    n0l, N1, N2 = shape
+    if period is None:
+        period = shape
+    period = tuple(int(p) for p in period)
+    idx, wts = _neighbor_products(pos, resampler, period, origin)
+    i0, i1, i2 = idx
+    w0, w1, w2 = wts
+    lin = ((i0[:, :, None, None] * N1 + i1[:, None, :, None]) * N2
+           + i2[:, None, None, :])
+    w = (w0[:, :, None, None] * w1[:, None, :, None] * w2[:, None, None, :])
+    valid = (i0[:, :, None, None] >= 0) & (i0[:, :, None, None] < n0l)
+    lin = jnp.where(valid, lin, 0)
+    w = jnp.where(valid, w, 0.0)
+    vals = block.reshape(-1)[lin.reshape(lin.shape[0], -1)]
+    return jnp.sum(vals * w.reshape(w.shape[0], -1).astype(vals.dtype),
+                   axis=-1)

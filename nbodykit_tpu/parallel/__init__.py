@@ -1,0 +1,19 @@
+"""Parallel substrate: device-mesh runtime, distributed FFT, particle
+exchange, halo exchange, distributed sort, and collective helpers.
+
+This package replaces the reference's L0/L1 parallel substrate (mpi4py +
+pmesh/pfft + mpsort; see SURVEY.md §1-2) with JAX-native equivalents built
+on ``jax.sharding.Mesh`` + ``jax.shard_map`` + XLA collectives.
+"""
+
+from .runtime import CurrentMesh, use_mesh, cpu_mesh, tpu_mesh, single_device_mesh
+from .dfft import dist_rfftn, dist_irfftn, dist_fft_plan
+from .halo import halo_add, halo_fill
+from .exchange import exchange_by_dest, auto_capacity
+
+__all__ = [
+    'CurrentMesh', 'use_mesh', 'cpu_mesh', 'tpu_mesh', 'single_device_mesh',
+    'dist_rfftn', 'dist_irfftn', 'dist_fft_plan',
+    'halo_add', 'halo_fill',
+    'exchange_by_dest', 'auto_capacity',
+]

@@ -1,0 +1,150 @@
+"""Particle exchange: route particles to the device that owns their slab.
+
+The reference's equivalent is ``pmesh.domain.GridND.decompose`` +
+``layout.exchange`` — an MPI all-to-allv of a ragged particle partition
+(used for painting at nbodykit/source/mesh/catalog.py:271-284, FOF at
+algorithms/fof.py:401, pair counting at pair_counters/domain.py:116).
+
+XLA wants static shapes, so the ragged all-to-allv becomes a
+*fixed-capacity* exchange (SURVEY.md §7 "hard parts" #2):
+
+1. each device computes dest(p) for its local particles;
+2. particles are bucketed into a (P, capacity) send buffer by
+   sort-by-destination + masked scatter;
+3. one ``lax.all_to_all`` ships the buckets;
+4. the receive side is a (P, capacity) buffer with a validity mask.
+
+Capacity policy: when called eagerly (the normal case — paint/readout
+size their buffers before tracing), :func:`auto_capacity` computes the
+*exact* max per-(src,dst) count, so overflow cannot happen. Under a
+trace, callers must pass an explicit capacity; the ``dropped`` count is
+returned so they can detect overflow outside jit and retry larger — the
+same contract as the reference's paint-chunk backoff loop
+(source/mesh/catalog.py:275-315).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .runtime import AXIS, mesh_size
+
+
+def auto_capacity(dest, nproc, slack=1.05):
+    """Exact sufficient per-(src,dst)-pair capacity for an exchange.
+
+    Max over (src, dst) pairs of the particle count, assuming particles
+    are evenly sharded over devices in index order (the layout of a
+    freshly created global array, matching the padding in
+    :func:`exchange_by_dest`). Cheap; call *outside* jit so the result
+    can size static buffers.
+    """
+    n = int(dest.shape[0])
+    per = -(-n // nproc)  # ceil: matches the even sharding of the pad
+    src = jnp.arange(n, dtype=jnp.int32) // per
+    pair = src * nproc + jnp.asarray(dest, jnp.int32)
+    counts = jnp.bincount(pair, length=nproc * nproc)
+    return int(np.ceil(int(counts.max()) * slack)) + 8
+
+
+def _bucket_local(dest, arrays, nproc, capacity, fill=0.0):
+    """Pack per-particle payloads into a (nproc, capacity, ...) send buffer.
+
+    dest : (n,) int32 destination device per particle
+    arrays : list of (n, ...) payloads
+    Returns (buffers, valid, dropped): buffers[i] has shape
+    (nproc, capacity, ...); valid is (nproc, capacity) bool.
+    """
+    n = dest.shape[0]
+    order = jnp.argsort(dest)
+    dest_s = dest[order]
+    # rank of each particle within its destination bucket
+    idx = jnp.arange(n, dtype=jnp.int32)
+    start = jnp.searchsorted(dest_s, jnp.arange(nproc, dtype=dest_s.dtype),
+                             side='left')
+    rank_in_bucket = idx - start[dest_s]
+    ok = rank_in_bucket < capacity
+    dropped = jnp.sum(~ok)
+    slot = jnp.where(ok, dest_s * capacity + rank_in_bucket, nproc * capacity)
+    valid = jnp.zeros((nproc * capacity + 1,), dtype=bool).at[slot].set(True)
+    valid = valid[:-1].reshape(nproc, capacity)
+    out = []
+    for a in arrays:
+        a_s = a[order]
+        buf_shape = (nproc * capacity + 1,) + a.shape[1:]
+        buf = jnp.full(buf_shape, fill, dtype=a.dtype).at[slot].set(a_s)
+        out.append(buf[:-1].reshape((nproc, capacity) + a.shape[1:]))
+    return out, valid, dropped
+
+
+def exchange_by_dest(dest, arrays, mesh, capacity=None, fill=0.0):
+    """All-to-all exchange of per-particle payloads keyed by destination.
+
+    Parameters
+    ----------
+    dest : global (N,) int32, sharded on axis 0 — destination device index
+        in [0, P)
+    arrays : list of global (N, ...) payloads, sharded on axis 0
+    mesh : device mesh (may be None / size 1)
+    capacity : int or None — max particles shipped per (src, dst) pair;
+        None (only valid eagerly) computes the exact bound via
+        :func:`auto_capacity`.
+
+    Returns
+    -------
+    recv : list of global (P * P * capacity, ...) arrays sharded on axis 0
+        (each device ends with P * capacity slots)
+    valid : matching (P*P*capacity,) bool mask (False = empty slot or
+        padding)
+    dropped : () int32 — particles lost to capacity overflow; zero by
+        construction when capacity=None. Check outside jit.
+
+    N need not divide P: inputs are padded to a multiple of P and the
+    padding arrives with valid=False.
+    """
+    nproc = mesh_size(mesh)
+    n = dest.shape[0]
+    if nproc == 1:
+        return list(arrays), jnp.ones(n, dtype=bool), jnp.zeros((), jnp.int32)
+
+    # pad the particle axis to a multiple of P; padding goes to dest 0
+    # with live=False and is masked out on arrival
+    live = jnp.ones(n, dtype=bool)
+    npad = (-n) % nproc
+    if npad:
+        dest = jnp.concatenate([dest, jnp.zeros(npad, dest.dtype)])
+        live = jnp.concatenate([live, jnp.zeros(npad, bool)])
+        arrays = [jnp.concatenate(
+            [a, jnp.zeros((npad,) + a.shape[1:], a.dtype)]) for a in arrays]
+
+    if capacity is None:
+        if isinstance(dest, jax.core.Tracer):
+            raise ValueError("exchange_by_dest(capacity=None) under jit: "
+                             "pass an explicit capacity when tracing")
+        capacity = auto_capacity(dest, nproc)  # after padding: exact
+
+    payloads = [live] + list(arrays)
+
+    def local(dest_l, *payloads_l):
+        bufs, valid, dropped = _bucket_local(dest_l, payloads_l, nproc,
+                                             capacity, fill)
+        outs = []
+        for b in bufs:
+            r = jax.lax.all_to_all(b, AXIS, split_axis=0, concat_axis=0,
+                                   tiled=True)
+            outs.append(r.reshape((nproc * capacity,) + r.shape[2:]))
+        v = jax.lax.all_to_all(valid, AXIS, split_axis=0, concat_axis=0,
+                               tiled=True)
+        dropped = jax.lax.psum(dropped, AXIS)
+        return (v.reshape(-1), dropped) + tuple(outs)
+
+    in_specs = (P(AXIS),) + tuple(
+        P(*((AXIS,) + (None,) * (a.ndim - 1))) for a in payloads)
+    out_specs = (P(AXIS), P()) + tuple(
+        P(*((AXIS,) + (None,) * (a.ndim - 1))) for a in payloads)
+    res = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs)(dest, *payloads)
+    slot_valid, dropped, live_recv = res[0], res[1], res[2]
+    valid = slot_valid & live_recv
+    return list(res[3:]), valid, dropped

@@ -1,0 +1,91 @@
+"""Halo (ghost-zone) exchange for slab-decomposed fields.
+
+The reference ghosts *particles* across rank boundaries before painting
+(``pm.decompose(pos, smoothing)`` → ``layout.exchange``, used at
+nbodykit/source/mesh/catalog.py:271-284). On TPU it is cheaper to ghost
+*mesh rows*: each device paints into a local slab extended by ``h`` rows on
+each side, then the halo rows are shipped to the owning neighbors with
+``lax.ppermute`` and added (``halo_add``); the reverse direction
+(``halo_fill``) replicates neighbor rows before a readout/gather.
+
+Layout convention (P devices, n0 = N0 // P rows per device):
+device d owns global rows [d*n0, (d+1)*n0); its extended buffer has shape
+(n0 + 2h, N1, N2) covering global rows [d*n0 - h, (d+1)*n0 + h), periodic.
+
+These functions are *per-device* primitives meant to be called inside
+``shard_map`` (they use collectives with axis name 'dev').
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .runtime import AXIS
+
+
+def _perms(nproc):
+    fwd = [(i, (i + 1) % nproc) for i in range(nproc)]  # send to next
+    bwd = [(i, (i - 1) % nproc) for i in range(nproc)]  # send to prev
+    return fwd, bwd
+
+
+def halo_add(ext, h, nproc):
+    """Fold the halo rows of an extended slab back onto the owners.
+
+    Parameters
+    ----------
+    ext : (n0 + 2h, ...) per-device extended buffer (inside shard_map)
+    h : int, halo width (= resampler support)
+    nproc : int, number of devices along 'dev'
+
+    Returns
+    -------
+    (n0, ...) per-device interior with neighbor halo contributions added.
+    """
+    n0 = ext.shape[0] - 2 * h
+    interior = ext[h:h + n0]
+    if h == 0:
+        return interior
+    lo = ext[:h]              # rows owned by device d-1
+    hi = ext[h + n0:]         # rows owned by device d+1
+    if nproc == 1:
+        # periodic wrap within the single slab
+        interior = interior.at[-h:].add(lo)
+        interior = interior.at[:h].add(hi)
+        return interior
+    fwd, bwd = _perms(nproc)
+    # my lo rows belong to d-1 => send backward; I receive d+1's lo = my tail rows
+    lo_recv = jax.lax.ppermute(lo, AXIS, bwd)
+    # my hi rows belong to d+1 => send forward; I receive d-1's hi = my head rows
+    hi_recv = jax.lax.ppermute(hi, AXIS, fwd)
+    interior = interior.at[n0 - h:].add(lo_recv)
+    interior = interior.at[:h].add(hi_recv)
+    return interior
+
+
+def halo_fill(interior, h, nproc):
+    """Build an extended slab whose halo rows replicate the neighbors.
+
+    Inverse-direction companion of :func:`halo_add`, used before readout.
+
+    Parameters
+    ----------
+    interior : (n0, ...) per-device slab (inside shard_map)
+
+    Returns
+    -------
+    (n0 + 2h, ...) extended buffer with periodic neighbor rows filled in.
+    """
+    if h == 0:
+        return interior
+    n0 = interior.shape[0]
+    head = interior[:h]        # my first rows -> previous device's hi halo
+    tail = interior[n0 - h:]   # my last rows  -> next device's lo halo
+    if nproc == 1:
+        lo, hi = tail, head
+    else:
+        fwd, bwd = _perms(nproc)
+        # my lo halo replicates d-1's tail: d-1 sends its tail forward
+        lo = jax.lax.ppermute(tail, AXIS, fwd)
+        # my hi halo replicates d+1's head: d+1 sends its head backward
+        hi = jax.lax.ppermute(head, AXIS, bwd)
+    return jnp.concatenate([lo, interior, hi], axis=0)

@@ -1,0 +1,126 @@
+"""Device-mesh runtime: the ambient parallel context.
+
+The reference's ambient context is a stack of MPI communicators
+(``CurrentMPIComm``, nbodykit/__init__.py:107-190) injected into every
+distributed object. Here the ambient context is a ``jax.sharding.Mesh``
+over the available devices — or ``None``, meaning single-device execution
+with no collectives.
+
+Conventions
+-----------
+- The device mesh is 1-D with axis name ``'dev'``. 3-D fields are slab
+  decomposed: a real field of global shape (N0, N1, N2) is sharded
+  ``P('dev', None, None)``; catalogs shard their particle axis the same way.
+- ``CurrentMesh.get()`` returns the ambient mesh (possibly ``None``).
+  Constructors accept ``comm=`` (kept for familiarity with the reference
+  API) holding a ``jax.sharding.Mesh``.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = 'dev'
+
+
+def single_device_mesh(device=None):
+    """A 1-device mesh (collectives become no-ops)."""
+    if device is None:
+        device = jax.devices()[0]
+    return Mesh(np.array([device]), (AXIS,))
+
+
+def cpu_mesh(n=None):
+    """A 1-D mesh over n CPU devices (for testing multi-device logic).
+
+    Requires ``JAX_NUM_CPU_DEVICES`` (or the xla_force_host_platform flag)
+    to have been set before jax initialization for n > 1.
+    """
+    devs = jax.devices('cpu')
+    if n is not None:
+        devs = devs[:n]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def tpu_mesh(n=None):
+    """A 1-D mesh over the available accelerator devices."""
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+class CurrentMesh(object):
+    """A stack of ambient device meshes, mirroring the reference's
+    ``CurrentMPIComm`` stack semantics (nbodykit/__init__.py:107-190)."""
+
+    _stack = [None]
+
+    @classmethod
+    def get(cls):
+        """The current ambient mesh (``None`` → single-device)."""
+        return cls._stack[-1]
+
+    @classmethod
+    def push(cls, mesh):
+        cls._stack.append(mesh)
+
+    @classmethod
+    def pop(cls):
+        if len(cls._stack) == 1:
+            raise RuntimeError("cannot pop the root mesh")
+        return cls._stack.pop()
+
+    @classmethod
+    def resolve(cls, comm):
+        """Resolve a ``comm=`` argument: explicit mesh wins, else ambient."""
+        if comm is not None:
+            return comm
+        return cls.get()
+
+
+class use_mesh(object):
+    """Context manager pushing a device mesh as the ambient context::
+
+        with use_mesh(tpu_mesh()):
+            cat = UniformCatalog(nbar, BoxSize, seed=42)
+    """
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        CurrentMesh.push(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *args):
+        CurrentMesh.pop()
+
+
+def mesh_size(mesh):
+    """Number of devices along the shard axis (1 when mesh is None)."""
+    if mesh is None:
+        return 1
+    return mesh.shape[AXIS]
+
+
+def sharding(mesh, *spec):
+    """NamedSharding for the given partition spec on this mesh, or None."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_leading(mesh, arr):
+    """Place a global array so its leading axis is sharded over the mesh."""
+    if mesh is None:
+        return arr
+    spec = (AXIS,) + (None,) * (arr.ndim - 1)
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(mesh, arr):
+    """Place an array fully replicated over the mesh."""
+    if mesh is None:
+        return arr
+    return jax.device_put(arr, NamedSharding(mesh, P()))

@@ -1,0 +1,103 @@
+"""Small shared utilities (reference analog: nbodykit/utils.py).
+
+The distributed-collective helpers of the reference (GatherArray/
+ScatterArray, utils.py:128,249) are unnecessary here — global jax.Arrays
+already are the gathered view — but JSON encoding of numpy-laden attrs
+dicts (utils.py:381-489) and a few array helpers carry over.
+"""
+
+import json
+
+import numpy as np
+import jax
+
+
+def as_numpy(arr):
+    """Fetch a jax array to host numpy.
+
+    Complex arrays are moved as real/imag pairs: the axon TPU runtime
+    does not implement complex-dtype host transfers (a failed attempt
+    poisons the process), while in-graph complex math is fine.
+    """
+    arr = jax.numpy.asarray(arr)
+    if jax.numpy.iscomplexobj(arr):
+        return np.asarray(arr.real) + 1j * np.asarray(arr.imag)
+    return np.asarray(arr)
+
+
+def to_device_complex(arr_np, sharding=None):
+    """Place a host complex array on device via a real/imag pair
+    (inverse of :func:`as_numpy` for complex inputs)."""
+    re = jax.device_put(np.ascontiguousarray(arr_np.real), sharding)
+    im = jax.device_put(np.ascontiguousarray(arr_np.imag), sharding)
+    return jax.lax.complex(re, im)
+
+
+class JSONEncoder(json.JSONEncoder):
+    """JSON encoder handling numpy scalars/arrays and complex values,
+    mirroring the reference's persistence format (nbodykit/utils.py:381):
+    arrays become {'__dtype__': ..., '__shape__': ..., '__data__': ...}.
+    """
+
+    def default(self, obj):
+        if isinstance(obj, jax.Array):
+            obj = as_numpy(obj)
+        if isinstance(obj, np.generic):
+            obj = obj.item()
+        if isinstance(obj, complex):
+            return {'__complex__': [obj.real, obj.imag]}
+        if isinstance(obj, np.ndarray):
+            if obj.dtype.kind == 'c':
+                data = np.stack([obj.real, obj.imag], axis=-1).tolist()
+            elif obj.dtype.kind == 'V':  # structured
+                data = {name: self.default(np.ascontiguousarray(obj[name]))
+                        for name in obj.dtype.names}
+            else:
+                data = obj.tolist()
+            return {'__dtype__': obj.dtype.str if obj.dtype.kind != 'V'
+                    else [list(x) for x in obj.dtype.descr],
+                    '__shape__': list(obj.shape),
+                    '__data__': data}
+        if isinstance(obj, (bool, int, float, str)) or obj is None:
+            return obj
+        try:
+            return json.JSONEncoder.default(self, obj)
+        except TypeError:
+            return str(obj)
+
+
+def json_object_hook(value):
+    """Decoder hook inverting :class:`JSONEncoder`."""
+    if '__complex__' in value:
+        re, im = value['__complex__']
+        return complex(re, im)
+    if '__dtype__' in value:
+        dtype = value['__dtype__']
+        shape = tuple(value['__shape__'])
+        data = value['__data__']
+        if isinstance(dtype, list):  # structured
+            dtype = np.dtype([(str(n), str(t)) for n, t in
+                              (tuple(x) for x in dtype)])
+            arr = np.empty(shape, dtype=dtype)
+            for name in dtype.names:
+                arr[name] = json_object_hook(data[name]) \
+                    if isinstance(data[name], dict) else data[name]
+            return arr
+        dt = np.dtype(str(dtype))
+        if dt.kind == 'c':
+            a = np.asarray(data, dtype='f8')
+            return (a[..., 0] + 1j * a[..., 1]).astype(dt).reshape(shape)
+        return np.asarray(data, dtype=dt).reshape(shape)
+    return value
+
+
+class JSONDecoder(json.JSONDecoder):
+    def __init__(self, *args, **kwargs):
+        kwargs['object_hook'] = json_object_hook
+        json.JSONDecoder.__init__(self, *args, **kwargs)
+
+
+def attrs_to_dict(attrs, prefix=''):
+    """Flatten an attrs dict with a prefix (reference analog used when
+    saving meta-data to file headers)."""
+    return {prefix + k: v for k, v in attrs.items()}

@@ -1,0 +1,42 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual devices so the multi-device code paths
+(shard_map collectives, distributed FFT, halo exchange) are exercised
+without TPU hardware — the analog of the reference CI running the same
+suite under ``mpirun -n 4`` (reference .github/workflows/main.yaml:44-49).
+
+The axon sitecustomize imports jax at interpreter startup (so env vars
+like JAX_NUM_CPU_DEVICES set here would be too late) but does not
+initialize backends; jax.config.update still works and is the reliable
+way to get 8 CPU devices + CPU default + x64.
+"""
+
+import jax
+import numpy as np  # noqa: F401
+import pytest
+
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+assert len(jax.devices("cpu")) == 8, \
+    "multi-device test setup failed: expected 8 CPU devices"
+
+
+@pytest.fixture(scope='session')
+def cpu8():
+    """An 8-device CPU mesh."""
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+    return cpu_mesh()
+
+
+# Parametrized ambient mesh: single device and the 8-device CPU mesh.
+# Mirrors the reference's `@pytest.mark.parametrize("comm", [MPI.COMM_WORLD])`
+# + 1-rank/4-rank CI matrix: the same test body must give device-count
+# invariant results.
+@pytest.fixture(params=['single', 'multi'])
+def comm(request):
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+    if request.param == 'single':
+        return cpu_mesh(1)
+    return cpu_mesh()

@@ -1,0 +1,165 @@
+"""Core ParticleMesh tests: FFT round-trip/correctness vs numpy, paint
+mass conservation + cross-device-count invariance, readout consistency.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nbodykit_tpu.pmesh import ParticleMesh
+from nbodykit_tpu.parallel import dfft
+from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+
+def test_dist_rfftn_matches_numpy(cpu8):
+    rng = np.random.RandomState(42)
+    x = rng.standard_normal((16, 24, 20))
+    want = np.fft.rfftn(x).transpose(1, 0, 2)
+
+    got1 = dfft.dist_rfftn(jnp.asarray(x), None)
+    np.testing.assert_allclose(np.asarray(got1), want, rtol=1e-9, atol=1e-8)
+
+    got8 = dfft.dist_rfftn(jnp.asarray(x), cpu8)
+    np.testing.assert_allclose(np.asarray(got8), want, rtol=1e-9, atol=1e-8)
+
+
+def test_dist_irfftn_roundtrip(cpu8):
+    rng = np.random.RandomState(1)
+    x = rng.standard_normal((16, 8, 12))
+    y = dfft.dist_rfftn(jnp.asarray(x), cpu8)
+    back = dfft.dist_irfftn(y, 12, cpu8)
+    np.testing.assert_allclose(np.asarray(back), x, rtol=1e-9, atol=1e-9)
+
+
+def test_r2c_normalization(comm):
+    # pmesh convention: r2c divides by Ntot, so DC mode = mean of field
+    pm = ParticleMesh(8, 1.0, dtype='f8', comm=comm)
+    field = pm.create('real', value=3.0)
+    c = pm.r2c(field)
+    np.testing.assert_allclose(complex(c[0, 0, 0]), 3.0, rtol=1e-12)
+    back = pm.c2r(c)
+    np.testing.assert_allclose(np.asarray(back), 3.0, rtol=1e-6)
+
+
+@pytest.mark.parametrize("resampler", ['nnb', 'cic', 'tsc', 'pcs'])
+def test_paint_mass_conservation(comm, resampler):
+    pm = ParticleMesh(32, 100.0, dtype='f8', comm=comm)
+    rng = np.random.RandomState(7)
+    pos = jnp.asarray(rng.uniform(0, 100.0, size=(1000, 3)))
+    mass = jnp.asarray(rng.uniform(0.5, 1.5, size=1000))
+    field = pm.paint(pos, mass, resampler=resampler)
+    np.testing.assert_allclose(float(field.sum()), float(mass.sum()),
+                               rtol=1e-10)
+
+
+@pytest.mark.parametrize("resampler", ['cic', 'tsc'])
+def test_paint_device_count_invariance(resampler):
+    rng = np.random.RandomState(3)
+    pos_np = rng.uniform(0, 50.0, size=(4096, 3))
+    fields = []
+    for comm in [cpu_mesh(1), cpu_mesh(2), cpu_mesh()]:
+        pm = ParticleMesh(32, 50.0, dtype='f8', comm=comm)
+        field = pm.paint(jnp.asarray(pos_np), 1.0, resampler=resampler)
+        fields.append(np.asarray(field))
+    np.testing.assert_allclose(fields[0], fields[1], rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(fields[0], fields[2], rtol=1e-10, atol=1e-12)
+
+
+def test_paint_nnb_is_histogram(comm):
+    pm = ParticleMesh(8, 8.0, dtype='f8', comm=comm)
+    rng = np.random.RandomState(11)
+    pos_np = rng.uniform(0, 8.0, size=(500, 3))
+    field = np.asarray(pm.paint(jnp.asarray(pos_np), 1.0, resampler='nnb'))
+    # nnb: each particle deposits into the nearest cell center => cell
+    # index = floor(x + 0.5) mod N at unit cell size
+    idx = np.floor(pos_np + 0.5).astype(int) % 8
+    want = np.zeros((8, 8, 8))
+    np.add.at(want, (idx[:, 0], idx[:, 1], idx[:, 2]), 1.0)
+    np.testing.assert_array_equal(field, want)
+
+
+def test_readout_constant_field(comm):
+    # interpolating a constant field returns the constant for any window
+    pm = ParticleMesh(32, 32.0, dtype='f8', comm=comm)
+    field = pm.create('real', value=5.0)
+    rng = np.random.RandomState(5)
+    pos = jnp.asarray(rng.uniform(0, 32.0, size=(300, 3)))
+    for resampler in ['cic', 'tsc', 'pcs']:
+        vals = pm.readout(field, pos, resampler=resampler)
+        np.testing.assert_allclose(np.asarray(vals), 5.0, rtol=1e-10)
+
+
+def test_readout_linear_gradient(comm):
+    # CIC exactly interpolates a linear function away from wrap edges
+    pm = ParticleMesh(16, 16.0, dtype='f8', comm=comm)
+    x = np.arange(16)
+    field = jnp.asarray(np.broadcast_to(
+        x[:, None, None], (16, 16, 16)).astype('f8'))
+    rng = np.random.RandomState(9)
+    pos_np = np.column_stack([
+        rng.uniform(2, 13, 200),      # away from the periodic seam
+        rng.uniform(0, 16, 200),
+        rng.uniform(0, 16, 200)])
+    vals = pm.readout(field, jnp.asarray(pos_np), resampler='cic')
+    np.testing.assert_allclose(np.asarray(vals), pos_np[:, 0], rtol=1e-9)
+
+
+def test_whitenoise_invariance_and_variance():
+    for N in [16]:
+        pms = [ParticleMesh(N, 1.0, dtype='f8', comm=c)
+               for c in [cpu_mesh(1), cpu_mesh()]]
+        etas = [np.asarray(pm.generate_whitenoise(seed=99)) for pm in pms]
+        np.testing.assert_allclose(etas[0], etas[1], rtol=1e-10)
+        # unit variance per complex mode (hermitian-sum over all modes)
+        eta = etas[0]
+        # total power sum_k |eta|^2 over the full (uncompressed) cube
+        w = np.ones(N // 2 + 1) * 2.0
+        w[0] = 1.0
+        w[-1] = 1.0
+        total = np.sum(np.abs(eta) ** 2 * w)
+        assert abs(total / N ** 3 - 1.0) < 0.05
+
+
+def test_whitenoise_unitary():
+    pm = ParticleMesh(8, 1.0, dtype='f8')
+    eta = np.asarray(pm.generate_whitenoise(seed=1, unitary=True))
+    np.testing.assert_allclose(np.abs(eta), 1.0, rtol=1e-10)
+
+
+def test_uniform_particle_grid(comm):
+    pm = ParticleMesh(8, 16.0, dtype='f8', comm=comm)
+    pos = np.asarray(pm.generate_uniform_particle_grid(shift=0.5))
+    assert pos.shape == (512, 3)
+    assert pos.min() == 1.0 and pos.max() == 15.0
+    # paint back with nnb: exactly one particle per cell
+    field = np.asarray(pm.paint(jnp.asarray(pos), 1.0, resampler='nnb'))
+    np.testing.assert_array_equal(field, np.ones((8, 8, 8)))
+
+
+def test_paint_clustered_no_mass_loss():
+    # all particles in one slab: auto-capacity must still not drop any
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+    pm = ParticleMesh(16, 16.0, dtype='f8', comm=cpu_mesh())
+    rng = np.random.RandomState(0)
+    pos_np = rng.uniform(0, 16.0, size=(4096, 3))
+    pos_np[:, 0] = rng.uniform(0.0, 1.5, size=4096)  # clustered in x
+    field = pm.paint(jnp.asarray(pos_np), 1.0, resampler='cic')
+    np.testing.assert_allclose(float(field.sum()), 4096.0, rtol=1e-10)
+
+
+def test_paint_non_divisible_N(comm):
+    # N=501 not divisible by 8 devices: padding path
+    pm = ParticleMesh(16, 16.0, dtype='f8', comm=comm)
+    rng = np.random.RandomState(2)
+    pos = jnp.asarray(rng.uniform(0, 16.0, size=(501, 3)))
+    field = pm.paint(pos, 1.0, resampler='cic')
+    np.testing.assert_allclose(float(field.sum()), 501.0, rtol=1e-10)
+
+
+def test_halo_too_wide_raises():
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+    pm = ParticleMesh(16, 16.0, dtype='f8', comm=cpu_mesh())  # n0 = 2
+    pos = jnp.asarray(np.random.RandomState(1).uniform(0, 16.0, (64, 3)))
+    with pytest.raises(ValueError, match="support"):
+        pm.paint(pos, 1.0, resampler='tsc')  # support 3 > n0 2
