@@ -1,0 +1,8 @@
+"""Algorithms (SURVEY.md §2 L4): FFT-based spectra estimators, group
+finders, pair counting, and histograms."""
+
+from .fftpower import FFTPower, ProjectedFFTPower, FFTBase, project_to_basis
+from .fftcorr import FFTCorr
+
+__all__ = ['FFTPower', 'ProjectedFFTPower', 'FFTBase', 'FFTCorr',
+           'project_to_basis']

@@ -1,0 +1,9 @@
+"""Distributed data containers: CatalogSource (particle tables) and
+MeshSource (3-D fields) — the L2 layer of SURVEY.md §1, re-designed for
+global sharded jax.Arrays instead of rank-local MPI blocks."""
+
+from .catalog import CatalogSource, CatalogSourceBase, column
+from .mesh import MeshSource, Field, FieldMesh
+
+__all__ = ['CatalogSource', 'CatalogSourceBase', 'column',
+           'MeshSource', 'Field', 'FieldMesh']

@@ -1,0 +1,17 @@
+"""The single import surface (reference: nbodykit/lab.py):
+
+    from nbodykit_tpu.lab import *
+"""
+
+from . import set_options, setup_logging, timer  # noqa: F401
+from .parallel.runtime import (CurrentMesh, use_mesh, cpu_mesh,  # noqa: F401
+                               tpu_mesh)
+from .pmesh import ParticleMesh  # noqa: F401
+from .binned_statistic import BinnedStatistic  # noqa: F401
+from .base.catalog import CatalogSource  # noqa: F401
+from .base.mesh import MeshSource, FieldMesh  # noqa: F401
+from .source.catalog import ArrayCatalog, RandomCatalog, UniformCatalog  # noqa: F401
+from .source.mesh import CatalogMesh, LinearMesh, ArrayMesh  # noqa: F401
+from .algorithms import (FFTPower, ProjectedFFTPower, FFTCorr,  # noqa: F401
+                         project_to_basis)
+from . import transform  # noqa: F401

@@ -1,0 +1,75 @@
+"""Device-count-invariant random number generation.
+
+The reference achieves rank-count invariance with MPIRandomState: a
+chunked seed table so each global chunk draws from its own seed stream
+regardless of which rank owns it (nbodykit/mpirng.py:5-136). Here the
+same property is free: draws are functions of (seed, call-counter,
+global shape) generated as global (sharded) arrays with jax's
+counter-based threefry — values never depend on the device layout.
+
+Each method call advances an internal counter (folded into the key), so
+a sequence of calls reproduces exactly given the same seed and call
+order — matching the stateful feel of numpy.random.RandomState that the
+reference's catalog constructors rely on.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .parallel.runtime import shard_leading
+
+
+class DistributedRNG(object):
+    """A stateful RandomState-like façade over jax.random producing
+    global arrays of length ``size`` (+ itemshape)."""
+
+    def __init__(self, seed, size, comm=None):
+        self.seed = int(seed)
+        self.size = int(size)
+        self.comm = comm
+        self._counter = 0
+
+    def _next_key(self):
+        key = jax.random.fold_in(jax.random.key(self.seed), self._counter)
+        self._counter += 1
+        return key
+
+    def _shape(self, itemshape):
+        if itemshape is None:
+            return (self.size,)
+        if np.isscalar(itemshape):
+            itemshape = (itemshape,)
+        return (self.size,) + tuple(itemshape)
+
+    def _place(self, arr):
+        from .parallel.runtime import mesh_size
+        if self.comm is not None and mesh_size(self.comm) > 1 \
+                and arr.shape[0] % mesh_size(self.comm) == 0:
+            arr = shard_leading(self.comm, arr)
+        return arr
+
+    def uniform(self, low=0.0, high=1.0, itemshape=None, dtype='f8'):
+        u = jax.random.uniform(self._next_key(), self._shape(itemshape),
+                               dtype=jnp.dtype(dtype), minval=low,
+                               maxval=high)
+        return self._place(u)
+
+    def normal(self, loc=0.0, scale=1.0, itemshape=None, dtype='f8'):
+        g = jax.random.normal(self._next_key(), self._shape(itemshape),
+                              dtype=jnp.dtype(dtype))
+        return self._place(g * scale + loc)
+
+    def poisson(self, lam, itemshape=None, dtype='i8'):
+        lam = jnp.asarray(lam)
+        shape = self._shape(itemshape)
+        if lam.ndim > 0:
+            shape = jnp.broadcast_shapes(shape, lam.shape)
+        p = jax.random.poisson(self._next_key(), lam, shape=shape)
+        return self._place(p.astype(jnp.dtype(dtype)))
+
+    def choice(self, choices, p=None, itemshape=None):
+        choices = jnp.asarray(choices)
+        c = jax.random.choice(self._next_key(), choices,
+                              shape=self._shape(itemshape), p=p)
+        return self._place(c)

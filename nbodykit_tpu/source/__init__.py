@@ -1,0 +1,2 @@
+"""Concrete catalog and mesh sources (SURVEY.md §2 'Catalog sources' /
+'Mesh sources')."""

@@ -1,0 +1,4 @@
+from .array import ArrayCatalog
+from .uniform import RandomCatalog, UniformCatalog
+
+__all__ = ['ArrayCatalog', 'RandomCatalog', 'UniformCatalog']

@@ -1,0 +1,34 @@
+"""ArrayCatalog: wrap in-memory columns as a CatalogSource
+(reference: nbodykit/source/catalog/array.py:7)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...base.catalog import CatalogSource
+
+
+class ArrayCatalog(CatalogSource):
+    """A catalog built from a dict of arrays or a structured numpy array.
+
+    Parameters
+    ----------
+    data : dict of (name -> array) or structured numpy array; all
+        leading dimensions must agree
+    **kwargs : stored in :attr:`attrs`
+    """
+
+    def __init__(self, data, comm=None, **kwargs):
+        if isinstance(data, np.ndarray) and data.dtype.names is not None:
+            data = {name: data[name] for name in data.dtype.names}
+        if not isinstance(data, dict):
+            raise TypeError("data must be a dict of arrays or a "
+                            "structured numpy array")
+        sizes = {k: np.shape(v)[0] for k, v in data.items()}
+        if len(set(sizes.values())) > 1:
+            raise ValueError("column length mismatch: %s" % sizes)
+        size = next(iter(sizes.values())) if sizes else 0
+
+        CatalogSource.__init__(self, size, comm=comm)
+        self.attrs.update(kwargs)
+        for name, value in data.items():
+            self[name] = jnp.asarray(value)

@@ -1,0 +1,69 @@
+"""Random and uniform catalogs (reference:
+nbodykit/source/catalog/uniform.py:6,62)."""
+
+import numpy as np
+
+from ...base.catalog import CatalogSource, column
+from ...rng import DistributedRNG
+
+
+class RandomCatalog(CatalogSource):
+    """A catalog whose columns are drawn from a device-count-invariant
+    random generator exposed as :attr:`rng`."""
+
+    def __init__(self, csize, seed=None, comm=None):
+        if seed is None:
+            seed = np.random.randint(0, 2 ** 31 - 1)
+        if csize == 0:
+            raise ValueError("no random particles generated!")
+        CatalogSource.__init__(self, csize, comm=comm)
+        self.attrs['seed'] = seed
+        self._rng = DistributedRNG(seed, csize, comm=self.comm)
+
+    @property
+    def rng(self):
+        return self._rng
+
+    def __repr__(self):
+        return "RandomCatalog(size=%d, seed=%s)" % (
+            self.size, self.attrs['seed'])
+
+
+class UniformCatalog(RandomCatalog):
+    """Uniformly distributed ``Position`` and ``Velocity`` in a box; the
+    total count is Poisson(nbar * volume) drawn from ``seed``."""
+
+    def __init__(self, nbar, BoxSize, seed=None, dtype='f8', comm=None):
+        _BoxSize = np.empty(3, dtype='f8')
+        _BoxSize[:] = BoxSize
+
+        if seed is None:
+            seed = np.random.randint(0, 2 ** 31 - 1)
+        N = int(np.random.RandomState(seed).poisson(
+            nbar * np.prod(_BoxSize)))
+        if N == 0:
+            raise ValueError("no uniform particles generated; "
+                             "increase nbar")
+        RandomCatalog.__init__(self, N, seed=seed, comm=comm)
+        self.attrs['BoxSize'] = _BoxSize
+        self.attrs['nbar'] = nbar
+
+        box = np.asarray(_BoxSize)
+        self._pos = (self.rng.uniform(itemshape=(3,), dtype=dtype) * box
+                     ).astype(dtype)
+        self._vel = (self.rng.uniform(itemshape=(3,), dtype=dtype) * box
+                     * 0.01).astype(dtype)
+
+    def __repr__(self):
+        return "UniformCatalog(size=%d, seed=%s)" % (
+            self.size, self.attrs['seed'])
+
+    @column
+    def Position(self):
+        """Uniform positions in [0, BoxSize)."""
+        return self._pos
+
+    @column
+    def Velocity(self):
+        """Uniform velocities in [0, 0.01*BoxSize)."""
+        return self._vel

@@ -1,0 +1,6 @@
+from .catalog import CatalogMesh
+from .linear import LinearMesh
+from .array import ArrayMesh
+from ...base.mesh import FieldMesh
+
+__all__ = ['CatalogMesh', 'LinearMesh', 'ArrayMesh', 'FieldMesh']

@@ -1,0 +1,28 @@
+"""ArrayMesh: wrap a host numpy array as a distributed MeshSource
+(reference: nbodykit/source/mesh/array.py:8, which scatters from the
+root rank; here device_put with a slab sharding does the same job)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...base.mesh import MeshSource, Field
+from ...parallel.runtime import shard_leading
+
+
+class ArrayMesh(MeshSource):
+    """A MeshSource from a concrete (Nmesh, Nmesh, Nmesh) numpy array."""
+
+    def __init__(self, array, BoxSize, comm=None, **kwargs):
+        array = np.asarray(array)
+        if array.ndim != 3:
+            raise ValueError("ArrayMesh expects a 3-D array")
+        MeshSource.__init__(self, array.shape, BoxSize,
+                            dtype=array.dtype.str, comm=comm)
+        self.attrs.update(kwargs)
+        value = jnp.asarray(array)
+        if self.comm is not None:
+            value = shard_leading(self.comm, value)
+        self._value = value
+
+    def to_real_field(self):
+        return Field(self._value, self.pm, 'real')

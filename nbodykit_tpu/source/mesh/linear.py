@@ -1,0 +1,52 @@
+"""LinearMesh: a Gaussian realization of a linear power spectrum
+(reference: nbodykit/source/mesh/linear.py:6)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...base.mesh import MeshSource, Field
+
+
+class LinearMesh(MeshSource):
+    """Gaussian field with a given power spectrum.
+
+    Parameters
+    ----------
+    Plin : callable P(k) -> power, in the box units
+    BoxSize, Nmesh : geometry
+    seed : int — realization seed (device-count invariant)
+    unitary_amplitude : bool — fix |delta_k| to its rms
+    inverted_phase : bool — flip the phase
+    """
+
+    def __init__(self, Plin, BoxSize, Nmesh, seed=None,
+                 unitary_amplitude=False, inverted_phase=False,
+                 dtype='f4', comm=None):
+        self.Plin = Plin
+        MeshSource.__init__(self, Nmesh, BoxSize, dtype=dtype, comm=comm)
+        if seed is None:
+            seed = np.random.randint(0, 2 ** 31 - 1)
+        self.attrs['seed'] = seed
+        self.attrs['unitary_amplitude'] = unitary_amplitude
+        self.attrs['inverted_phase'] = inverted_phase
+        if hasattr(Plin, 'attrs'):
+            self.attrs.update(Plin.attrs)
+
+    def to_complex_field(self):
+        """delta_k = whitenoise * sqrt(P(k) / V), zero DC (reference
+        recipe: mockmaker.py:7-141)."""
+        pm = self.pm
+        eta = pm.generate_whitenoise(
+            self.attrs['seed'],
+            unitary=self.attrs['unitary_amplitude'],
+            inverted_phase=self.attrs['inverted_phase'])
+        kx, ky, kz = pm.k_list(dtype=jnp.float64
+                               if pm.dtype.itemsize > 4 else jnp.float32)
+        k2 = kx ** 2 + ky ** 2 + kz ** 2
+        kmag = jnp.sqrt(k2)
+        V = float(np.prod(pm.BoxSize))
+        power = jnp.asarray(self.Plin(kmag))
+        amp = jnp.sqrt(jnp.where(power > 0, power, 0.0) / V)
+        delta_k = eta * amp.astype(eta.real.dtype)
+        delta_k = jnp.where(k2 == 0, 0.0, delta_k)
+        return Field(delta_k, pm, 'complex')

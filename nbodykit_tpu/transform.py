@@ -1,0 +1,107 @@
+"""Column transforms: stacking, concatenation, sky geometry.
+
+Reference: ``nbodykit/transform.py`` (dask-lazy column math). Here
+columns are jnp arrays, so these are jnp functions; the sky-coordinate
+conversions mirror the reference's conventions (:110-489).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def StackColumns(*cols):
+    """Stack 1-D columns into an (N, ncols) array (reference
+    transform.py:5)."""
+    cols = [jnp.asarray(c) for c in cols]
+    return jnp.stack(cols, axis=-1)
+
+
+def ConcatenateSources(*sources, **kwargs):
+    """Concatenate catalogs along the particle axis (reference
+    transform.py:29)."""
+    from .source.catalog.array import ArrayCatalog
+    columns = kwargs.get('columns', None)
+    if columns is None:
+        columns = sources[0].columns
+        for s in sources[1:]:
+            columns = [c for c in columns if c in s.columns]
+    data = {c: jnp.concatenate([s[c] for s in sources], axis=0)
+            for c in columns}
+    attrs = {}
+    for s in sources:
+        attrs.update(s.attrs)
+    return ArrayCatalog(data, comm=sources[0].comm, **attrs)
+
+
+def ConstantArray(value, size, chunks=None):
+    """A constant column (reference transform.py:89)."""
+    return jnp.broadcast_to(jnp.asarray(value), (size,) +
+                            np.shape(np.asarray(value))).reshape(
+        (size,) + np.shape(np.asarray(value)))
+
+
+def CartesianToEquatorial(pos, observer=[0, 0, 0], frame='icrs'):
+    """Cartesian -> (RA, Dec) degrees (reference transform.py:110)."""
+    pos = jnp.asarray(pos) - jnp.asarray(observer, dtype=jnp.asarray(pos).dtype)
+    s = jnp.hypot(pos[..., 0], pos[..., 1])
+    lon = jnp.degrees(jnp.arctan2(pos[..., 1], pos[..., 0])) % 360.0
+    lat = jnp.degrees(jnp.arctan2(pos[..., 2], s))
+    return lon, lat
+
+
+def SkyToUnitSphere(ra, dec, degrees=True):
+    """(RA, Dec) -> unit vectors (reference transform.py:266)."""
+    ra = jnp.asarray(ra)
+    dec = jnp.asarray(dec)
+    if degrees:
+        ra = jnp.radians(ra)
+        dec = jnp.radians(dec)
+    x = jnp.cos(dec) * jnp.cos(ra)
+    y = jnp.cos(dec) * jnp.sin(ra)
+    z = jnp.sin(dec)
+    return jnp.stack([x, y, z], axis=-1)
+
+
+def SkyToCartesian(ra, dec, redshift, cosmo, observer=[0, 0, 0],
+                   degrees=True):
+    """(RA, Dec, z) -> comoving Cartesian, in Mpc/h (reference
+    transform.py:331)."""
+    pos = SkyToUnitSphere(ra, dec, degrees=degrees)
+    r = jnp.asarray(cosmo.comoving_distance(np.asarray(redshift)))
+    return r[..., None] * pos + jnp.asarray(observer,
+                                            dtype=pos.dtype)
+
+
+def CartesianToSky(pos, cosmo, velocity=None, observer=[0, 0, 0],
+                   zmax=100.0, frame='icrs'):
+    """Cartesian -> (RA, Dec, z[, z_rsd]) (reference transform.py:179).
+
+    Redshift is inverted from the comoving distance on an interpolation
+    grid out to ``zmax``.
+    """
+    pos = jnp.asarray(pos) - jnp.asarray(observer, dtype=jnp.asarray(pos).dtype)
+    ra, dec = CartesianToEquatorial(pos)
+    r = jnp.sqrt((pos ** 2).sum(axis=-1))
+
+    zgrid = np.concatenate([[0.0], np.logspace(-8, np.log10(zmax), 1024)])
+    rgrid = np.asarray(cosmo.comoving_distance(zgrid))
+    z = jnp.interp(r, jnp.asarray(rgrid), jnp.asarray(zgrid))
+
+    if velocity is not None:
+        velocity = jnp.asarray(velocity)
+        rhat = pos / jnp.where(r == 0, 1.0, r)[..., None]
+        vpec = (velocity * rhat).sum(axis=-1)
+        z_rsd = z + vpec / 299792.458 * (1 + z)
+        return ra, dec, z, z_rsd
+    return ra, dec, z
+
+
+def VectorProjection(vector, direction):
+    """Project ``vector`` onto ``direction`` (reference
+    transform.py:489)."""
+    vector = jnp.asarray(vector)
+    direction = jnp.asarray(direction, dtype=vector.dtype)
+    direction = direction / jnp.sqrt(
+        (direction ** 2).sum(axis=-1, keepdims=True))
+    amp = (vector * direction).sum(axis=-1, keepdims=True)
+    return amp * direction

@@ -1,0 +1,107 @@
+"""BinnedStatistic container tests (reference analog:
+nbodykit/tests/test_binned_stat.py)."""
+
+import numpy as np
+import pytest
+
+from nbodykit_tpu.binned_statistic import BinnedStatistic
+
+
+def make_2d():
+    kedges = np.linspace(0, 1, 11)
+    muedges = np.linspace(-1, 1, 6)
+    shape = (10, 5)
+    rng = np.random.RandomState(0)
+    data = np.empty(shape, dtype=[('k', 'f8'), ('mu', 'f8'),
+                                  ('power', 'c16'), ('modes', 'f8')])
+    data['k'] = 0.5 * (kedges[1:] + kedges[:-1])[:, None] * np.ones(shape)
+    data['mu'] = 0.5 * (muedges[1:] + muedges[:-1])[None, :] * np.ones(shape)
+    data['power'] = rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+    data['modes'] = rng.randint(1, 100, shape)
+    return BinnedStatistic(['k', 'mu'], [kedges, muedges], data,
+                           fields_to_sum=['modes'], attr1='hello')
+
+
+def test_basic_properties():
+    ds = make_2d()
+    assert ds.shape == (10, 5)
+    assert set(ds.variables) == {'k', 'mu', 'power', 'modes'}
+    assert ds.dims == ['k', 'mu']
+    assert ds.attrs['attr1'] == 'hello'
+    assert 'power' in ds
+    assert len(ds.coords['k']) == 10
+
+
+def test_getitem_variable_and_slice():
+    ds = make_2d()
+    assert ds['power'].shape == (10, 5)
+    sub = ds[['k', 'power']]
+    assert set(sub.variables) == {'k', 'power'}
+    sliced = ds[2:5]
+    assert sliced.shape == (3, 5)
+    np.testing.assert_allclose(sliced['power'], ds['power'][2:5])
+    col = ds[:, 0]
+    assert col.shape == (10, 1)
+
+
+def test_sel_and_squeeze():
+    ds = make_2d()
+    # scalar sel squeezes
+    one = ds.sel(mu=ds.coords['mu'][0])
+    assert one.dims == ['k']
+    # slice sel keeps
+    rng = ds.sel(k=slice(0.15, 0.55))
+    assert rng.dims == ['k', 'mu']
+    assert rng.shape[0] == 5
+    # nearest method
+    near = ds.sel(k=0.17, method='nearest')
+    assert near.dims == ['mu']
+
+
+def test_take():
+    ds = make_2d()
+    t = ds.take(k=ds.coords['k'] > 0.5)
+    assert t.shape == (5, 5)
+    t2 = ds.take(ds['modes'] > 0)
+    assert t2.shape == ds.shape
+
+
+def test_reindex_and_average():
+    ds = make_2d()
+    re = ds.reindex('k', 0.2)
+    assert re.shape == (5, 5)
+    # modes are summed, not averaged
+    np.testing.assert_allclose(
+        re['modes'], ds['modes'].reshape(5, 2, 5).sum(axis=1))
+    av = ds.average('mu')
+    assert av.dims == ['k']
+    np.testing.assert_allclose(av['modes'], ds['modes'].sum(axis=1))
+
+
+def test_json_roundtrip(tmp_path):
+    ds = make_2d()
+    fn = str(tmp_path / "ds.json")
+    ds.to_json(fn)
+    ds2 = BinnedStatistic.from_json(fn)
+    assert ds2.dims == ds.dims
+    np.testing.assert_allclose(ds2['power'].real, ds['power'].real)
+    np.testing.assert_allclose(ds2['power'].imag, ds['power'].imag)
+    np.testing.assert_allclose(ds2.edges['k'], ds.edges['k'])
+    assert ds2.attrs['attr1'] == 'hello'
+
+
+def test_rename_and_setitem():
+    ds = make_2d()
+    ds2 = ds.rename_variable('power', 'corr')
+    assert 'corr' in ds2.variables and 'power' not in ds2.variables
+    ds['extra'] = np.ones(ds.shape)
+    assert 'extra' in ds.variables
+    with pytest.raises(ValueError):
+        ds['bad'] = np.ones((3, 3))
+
+
+def test_copy_independent():
+    ds = make_2d()
+    cp = ds.copy()
+    cp['power'][...] = 0
+    assert not np.allclose(ds['power'], 0)
