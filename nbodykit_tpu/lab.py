@@ -15,3 +15,8 @@ from .source.mesh import CatalogMesh, LinearMesh, ArrayMesh  # noqa: F401
 from .algorithms import (FFTPower, ProjectedFFTPower, FFTCorr,  # noqa: F401
                          project_to_basis)
 from . import transform  # noqa: F401
+from .source.catalog import LogNormalCatalog  # noqa: F401,E402
+from . import cosmology  # noqa: F401,E402
+from .cosmology import (Cosmology, Planck13, Planck15,  # noqa: F401,E402
+                        WMAP5, WMAP7, WMAP9, LinearPower, HalofitPower,
+                        ZeldovichPower, CorrelationFunction)

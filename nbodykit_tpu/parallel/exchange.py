@@ -120,9 +120,13 @@ def exchange_by_dest(dest, arrays, mesh, capacity=None, fill=0.0):
 
     if capacity is None:
         if isinstance(dest, jax.core.Tracer):
-            raise ValueError("exchange_by_dest(capacity=None) under jit: "
-                             "pass an explicit capacity when tracing")
-        capacity = auto_capacity(dest, nproc)  # after padding: exact
+            # under a trace we cannot inspect the data: use the always-
+            # sufficient bound (one source sends its whole shard to one
+            # destination). Memory = P*cap = n slots per device; callers
+            # wanting tighter buffers pass capacity explicitly.
+            capacity = -(-dest.shape[0] // nproc)
+        else:
+            capacity = auto_capacity(dest, nproc)  # after padding: exact
 
     payloads = [live] + list(arrays)
 

@@ -1,4 +1,6 @@
 from .array import ArrayCatalog
 from .uniform import RandomCatalog, UniformCatalog
+from .lognormal import LogNormalCatalog
 
-__all__ = ['ArrayCatalog', 'RandomCatalog', 'UniformCatalog']
+__all__ = ['ArrayCatalog', 'RandomCatalog', 'UniformCatalog',
+           'LogNormalCatalog']

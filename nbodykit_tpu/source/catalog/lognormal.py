@@ -1,0 +1,98 @@
+"""LogNormalCatalog: lognormal + Zel'dovich mock galaxy catalog.
+
+Reference: ``nbodykit/source/catalog/lognormal.py:9`` (`_makesource`
+:137-190): Gaussian delta and displacement fields from a linear power
+spectrum, lognormal transform with bias, Poisson sampling, Zel'dovich
+position update, linear velocities v = f a H psi.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...base.catalog import CatalogSource, column
+from ...pmesh import ParticleMesh
+from ... import mockmaker
+
+
+class LogNormalCatalog(CatalogSource):
+    """Poisson-sampled lognormal realization of a linear power spectrum,
+    with Zel'dovich displacements and velocities.
+
+    Parameters
+    ----------
+    Plin : callable P(k); if it carries ``cosmo``/``redshift``
+        attributes (like LinearPower), they set the growth rate for
+        velocities
+    nbar : mean number density, in (box units)^-3
+    BoxSize, Nmesh : mesh geometry
+    bias : lognormal bias b (delta_g = exp(b delta) - 1)
+    seed : realization seed (device-count invariant)
+    cosmo, redshift : override Plin's attributes
+    """
+
+    def __init__(self, Plin, nbar, BoxSize, Nmesh, bias=2.0, seed=None,
+                 cosmo=None, redshift=None, unitary_amplitude=False,
+                 inverted_phase=False, dtype='f4', comm=None):
+        if seed is None:
+            seed = np.random.randint(0, 2 ** 31 - 1)
+
+        cosmo = cosmo if cosmo is not None else getattr(Plin, 'cosmo', None)
+        redshift = redshift if redshift is not None else \
+            getattr(Plin, 'redshift', None)
+
+        self._pm = ParticleMesh(Nmesh, BoxSize, dtype=dtype, comm=comm)
+        pm = self._pm
+
+        delta, disp = mockmaker.gaussian_real_fields(
+            pm, Plin, seed, unitary_amplitude=unitary_amplitude,
+            inverted_phase=inverted_phase, compute_displacement=True)
+
+        pos, psi = mockmaker.poisson_sample_to_points(
+            delta, disp, pm, nbar, bias=bias, seed=seed)
+
+        # Zel'dovich update: x -> x + psi (periodic wrap)
+        box = jnp.asarray(pm.BoxSize, pos.dtype)
+        pos = jnp.mod(pos + psi, box)
+
+        # velocities: v = f * a * H(a) * psi = f * 100 * E(z) / (1+z) psi
+        if cosmo is not None and redshift is not None:
+            f = float(cosmo.scale_independent_growth_rate(redshift))
+            E = float(cosmo.efunc(redshift))
+            vfac = f * 100.0 * E / (1.0 + redshift)
+        else:
+            f = 0.0
+            vfac = 0.0
+
+        CatalogSource.__init__(self, pos.shape[0], comm=comm)
+        self.attrs['BoxSize'] = pm.BoxSize.copy()
+        self.attrs['Nmesh'] = pm.Nmesh.copy()
+        self.attrs.update(nbar=nbar, bias=bias, seed=seed)
+        if redshift is not None:
+            self.attrs['redshift'] = redshift
+        if hasattr(Plin, 'attrs'):
+            self.attrs.update({k: v for k, v in Plin.attrs.items()
+                               if k not in self.attrs})
+
+        self._pos = pos
+        self._vel = (psi * vfac).astype(pos.dtype)
+        self._voff = (psi * f).astype(pos.dtype)  # f * psi, Mpc/h
+        self._cosmo = cosmo
+
+    @column
+    def Position(self):
+        return self._pos
+
+    @column
+    def Velocity(self):
+        return self._vel
+
+    @column
+    def VelocityOffset(self):
+        """RSD position offset f * psi in Mpc/h, so that
+        x_rsd = x + VelocityOffset . los (reference convention,
+        lognormal.py:189)."""
+        return self._voff
+
+    def __repr__(self):
+        return "LogNormalCatalog(size=%d, seed=%s)" % (
+            self.size, self.attrs['seed'])

@@ -15,9 +15,11 @@ import jax
 import numpy as np  # noqa: F401
 import pytest
 
+# cpu-only: keeps the (possibly unreachable) axon TPU backend from even
+# initializing — jax.devices() would otherwise block on its tunnel
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 assert len(jax.devices("cpu")) == 8, \
     "multi-device test setup failed: expected 8 CPU devices"

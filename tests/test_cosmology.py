@@ -1,0 +1,139 @@
+"""Cosmology tests: astropy cross-checks for the background (the
+reference's own oracle style, cosmology/tests/test_cosmology.py),
+physical limits for the power spectra, FFTLog round-trips.
+"""
+
+import numpy as np
+import pytest
+
+from nbodykit_tpu.cosmology import (Cosmology, Planck15, LinearPower,
+                                    HalofitPower, ZeldovichPower,
+                                    CorrelationFunction, pk_to_xi,
+                                    xi_to_pk)
+
+
+def test_background_vs_astropy():
+    ap = pytest.importorskip("astropy.cosmology")
+    c = Planck15
+    a = c.to_astropy()
+    z = np.array([0.0, 0.5, 1.0, 2.0, 5.0])
+    np.testing.assert_allclose(c.efunc(z), a.efunc(z), rtol=2e-3)
+    # comoving distance in Mpc/h vs astropy Mpc
+    ours = c.comoving_distance(z[1:])
+    theirs = a.comoving_distance(z[1:]).value * c.h
+    np.testing.assert_allclose(ours, theirs, rtol=3e-3)
+
+
+def test_growth_matter_dominated_limit():
+    # EdS: D = a exactly, f = 1
+    c = Cosmology(h=0.7, Omega0_b=0.05, Omega0_cdm=0.95 - 1e-5,
+                  N_ur=0.0, T0_cmb=1e-3)  # kill radiation
+    z = np.array([0.0, 1.0, 3.0])
+    D = c.scale_independent_growth_factor(z)
+    np.testing.assert_allclose(D, 1.0 / (1 + z), rtol=1e-3)
+    f = c.scale_independent_growth_rate(z)
+    np.testing.assert_allclose(f, 1.0, rtol=1e-3)
+
+
+def test_growth_rate_approximation():
+    # f(z) ~ Omega_m(z)^0.55 for LCDM
+    c = Planck15
+    z = np.array([0.0, 0.5, 1.0])
+    f = c.scale_independent_growth_rate(z)
+    approx = c.Omega_m(z) ** 0.55
+    np.testing.assert_allclose(f, approx, rtol=0.02)
+
+
+def test_linear_power_sigma8_scaling():
+    P = LinearPower(Planck15, 0.0)
+    s8 = P.sigma8
+    assert 0.5 < s8 < 1.2  # sane amplitude from A_s
+    P.sigma8 = 0.8
+    np.testing.assert_allclose(P.sigma8, 0.8, rtol=1e-10)
+    # P scales as sigma8^2
+    k = np.logspace(-2, 0, 10)
+    p1 = P(k)
+    P.sigma8 = 0.4
+    np.testing.assert_allclose(P(k), p1 / 4, rtol=1e-10)
+
+
+def test_linear_power_redshift_growth():
+    P0 = LinearPower(Planck15, 0.0)
+    P1 = LinearPower(Planck15, 1.0)
+    D = Planck15.scale_independent_growth_factor(1.0)
+    k = np.logspace(-2, 0, 8)
+    np.testing.assert_allclose(P1(k) / P0(k), D ** 2, rtol=1e-4)
+
+
+def test_transfer_normalization():
+    from nbodykit_tpu.cosmology.power.transfers import (
+        EisensteinHu, NoWiggleEisensteinHu)
+    for cls in [EisensteinHu, NoWiggleEisensteinHu]:
+        T = cls(Planck15)
+        k = np.array([1e-7, 1e-6])
+        np.testing.assert_allclose(T(k), 1.0, rtol=1e-3)
+        # monotonically decreasing envelope at high k
+        assert T(np.array([10.0]))[0] < 1e-2
+
+
+def test_wiggle_vs_nowiggle():
+    # the wiggly EH oscillates around the no-wiggle form within ~10%
+    Pw = LinearPower(Planck15, 0.0, transfer='EisensteinHu')
+    Pnw = LinearPower(Planck15, 0.0, transfer='NoWiggleEisensteinHu')
+    Pnw.sigma8 = Pw.sigma8
+    k = np.logspace(-2, 0, 256)
+    ratio = Pw(k) / Pnw(k)
+    assert np.all(np.abs(ratio - 1) < 0.12)
+    assert np.std(ratio) > 5e-3  # wiggles exist
+
+
+def test_halofit_enhances_small_scales():
+    Pl = LinearPower(Planck15, 0.0)
+    Pnl = HalofitPower(Planck15, 0.0, linear=Pl)
+    k = np.logspace(-3, 1, 64)
+    ratio = Pnl(k) / Pl(k)
+    # linear on large scales
+    assert abs(ratio[0] - 1) < 0.05
+    # nonlinear boost at k ~ 1-10
+    assert ratio[-1] > 2.0
+
+
+def test_zeldovich_low_k_limit():
+    Pz = ZeldovichPower(Planck15, 0.0)
+    Pl = Pz.linear
+    k = np.array([0.01, 0.02, 0.05])
+    np.testing.assert_allclose(Pz(k), Pl(k), rtol=0.05)
+    # BAO damping: ZA < linear at k ~ 0.1-0.2
+    k2 = np.array([0.2, 0.3])
+    assert np.all(Pz(k2) < Pl(k2))
+
+
+def test_pk_xi_roundtrip():
+    P = LinearPower(Planck15, 0.0)
+    k = np.logspace(-5, 2, 2048)
+    xi = pk_to_xi(k, P(k))
+    pk2 = xi_to_pk(np.logspace(-3, 3, 2048),
+                   xi(np.logspace(-3, 3, 2048)))
+    kt = np.logspace(-1.5, -0.5, 16)
+    np.testing.assert_allclose(pk2(kt), P(kt), rtol=0.05)
+
+
+def test_correlation_function_bao_peak():
+    P = LinearPower(Planck15, 0.0)
+    cf = CorrelationFunction(P)
+    r = np.linspace(60, 140, 161)
+    xi = cf(r)
+    # BAO peak near ~100 Mpc/h: local max of r^2 xi in [85, 115]
+    r2xi = r ** 2 * xi
+    ipk = np.argmax(r2xi)
+    assert 85 < r[ipk] < 115
+
+
+def test_clone_and_match():
+    c = Planck15
+    c2 = c.clone(h=0.7)
+    assert c2.h == 0.7 and c2.Omega0_b == c.Omega0_b
+    c3 = c.match(sigma8=0.8)
+    np.testing.assert_allclose(LinearPower(c3, 0).sigma8, 0.8, rtol=1e-5)
+    c4 = c.match(Omega0_m=0.3)
+    np.testing.assert_allclose(c4.Omega0_m, 0.3, rtol=1e-10)
