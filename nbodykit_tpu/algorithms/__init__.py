@@ -3,6 +3,8 @@ finders, pair counting, and histograms."""
 
 from .fftpower import FFTPower, ProjectedFFTPower, FFTBase, project_to_basis
 from .fftcorr import FFTCorr
+from .convpower import ConvolvedFFTPower, FKPCatalog, FKPWeightFromNbar
 
 __all__ = ['FFTPower', 'ProjectedFFTPower', 'FFTBase', 'FFTCorr',
+           'ConvolvedFFTPower', 'FKPCatalog', 'FKPWeightFromNbar',
            'project_to_basis']

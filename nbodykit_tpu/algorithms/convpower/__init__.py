@@ -1,0 +1,6 @@
+from .fkp import ConvolvedFFTPower, get_real_Ylm
+from .catalog import FKPCatalog, FKPWeightFromNbar
+from .catalogmesh import FKPCatalogMesh
+
+__all__ = ['ConvolvedFFTPower', 'FKPCatalog', 'FKPCatalogMesh',
+           'FKPWeightFromNbar', 'get_real_Ylm']

@@ -1,0 +1,107 @@
+"""FKPCatalogMesh: paint the FKP density field.
+
+Reference: ``nbodykit/algorithms/convpower/catalogmesh.py:7`` — paints
+F(x) = w_fkp * [w_comp n_data - alpha w_comp n_randoms] / cellvolume,
+with positions re-centered to [-L/2, L/2].
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...source.mesh.species import MultipleSpeciesCatalogMesh
+from ...source.mesh.catalog import CatalogMesh
+from ...base.mesh import Field
+from ...utils import as_numpy
+
+
+class FKPCatalogMesh(MultipleSpeciesCatalogMesh):
+
+    def __init__(self, source, BoxSize, BoxCenter, Nmesh, dtype,
+                 selection, comp_weight, fkp_weight, nbar, value='Value',
+                 position='Position', interlaced=False, compensated=False,
+                 resampler='cic'):
+        from .catalog import FKPCatalog
+        if not isinstance(source, FKPCatalog):
+            raise TypeError("FKPCatalogMesh requires an FKPCatalog")
+
+        self.attrs = dict(source.attrs)
+        self.attrs['BoxSize'] = np.ones(3) * BoxSize
+        self.attrs['BoxCenter'] = np.ones(3) * BoxCenter
+
+        self._uncentered_position = position
+        self.comp_weight = comp_weight
+        self.fkp_weight = fkp_weight
+        self.nbar = nbar
+
+        MultipleSpeciesCatalogMesh.__init__(
+            self, source=source, BoxSize=BoxSize, Nmesh=Nmesh,
+            dtype=dtype, weight='_TotalWeight', value=value,
+            selection=selection, position='_RecenteredPosition',
+            interlaced=interlaced, compensated=compensated,
+            resampler=resampler)
+
+    def RecenteredPosition(self, name):
+        """Positions shifted by -BoxCenter, i.e. into [-L/2, L/2]
+        (reference :206). The ParticleMesh grid covers [0, L); shift by
+        +L/2 so painting sees [0, L)."""
+        pos = self.source[name][self._uncentered_position]
+        center = jnp.asarray(self.attrs['BoxCenter'], pos.dtype)
+        return pos - center
+
+    def TotalWeight(self, name):
+        """comp_weight * fkp_weight (reference :217)."""
+        return (self.source[name][self.comp_weight]
+                * self.source[name][self.fkp_weight])
+
+    def weighted_total(self, name):
+        """W = sum of selected completeness weights (reference
+        weighted_total)."""
+        cat = self.source[name]
+        sel = cat[self.selection]
+        w = cat[self.comp_weight]
+        return float(jnp.where(sel, w, 0.0).sum())
+
+    def __getitem__(self, species):
+        if species not in self.source.species:
+            raise KeyError(species)
+        cat = self.source[species]
+        # provide derived columns on a shallow view of the species
+        half = jnp.asarray(self.attrs['BoxSize'] / 2.0)
+        view = cat.view()
+        pos = self.RecenteredPosition(species)
+        view['_RecenteredPosition'] = pos + jnp.asarray(
+            half, pos.dtype)  # paint grid covers [0, L)
+        view['_TotalWeight'] = self.TotalWeight(species)
+        return CatalogMesh(
+            view, Nmesh=self.attrs['Nmesh'], BoxSize=self.attrs['BoxSize'],
+            dtype=self.pm.dtype.str, interlaced=self.interlaced,
+            compensated=self.compensated, resampler=self.resampler,
+            position='_RecenteredPosition', weight='_TotalWeight',
+            value=self.value, selection=self.selection)
+
+    def to_real_field(self):
+        """The FKP density field (number density units); attrs carry
+        data.W / randoms.W / alpha and per-species paint meta-data."""
+        attrs = {}
+        for name in self.source.species:
+            attrs[name + '.W'] = self.weighted_total(name)
+        attrs['alpha'] = attrs['data.W'] / attrs['randoms.W'] \
+            if attrs['randoms.W'] > 0 else 1.0
+
+        data_field = self['data'].to_real_field(normalize=False)
+        for k, v in data_field.attrs.items():
+            attrs['data.' + k] = v
+        total = data_field.value
+
+        if len(self.source['randoms']) > 0:
+            ran_field = self['randoms'].to_real_field(normalize=False)
+            for k, v in ran_field.attrs.items():
+                attrs['randoms.' + k] = v
+            total = total - attrs['alpha'] * ran_field.value
+
+        vol_per_cell = float(np.prod(self.attrs['BoxSize'] /
+                                     self.attrs['Nmesh']))
+        total = total / vol_per_cell
+        attrs.pop('data.shotnoise', None)
+        attrs.pop('randoms.shotnoise', None)
+        return Field(total, self.pm, 'real', attrs)

@@ -1,0 +1,363 @@
+"""ConvolvedFFTPower: survey-geometry power-spectrum multipoles.
+
+Reference: ``nbodykit/algorithms/convpower/fkp.py:75`` — the Hand et
+al. 2017 estimator (building on Bianchi 2015 / Scoccimarro 2015): via
+the spherical-harmonic addition theorem, each multipole needs only
+2l+1 FFTs of Ylm-weighted density fields.
+
+TPU redesign: the reference generates real Ylm with sympy->numexpr
+codegen (:12-73); here they are closed-form jnp polynomials via the
+associated-Legendre recurrence (:func:`get_real_Ylm`), so the whole
+Ylm-weight -> FFT -> Ylm-weight -> accumulate loop stays inside jitted
+XLA programs over the sharded mesh.
+
+Limitation mirroring our hermitian mesh layout: the density mesh is
+stored real (r2c hermitian), which is exact for even multipoles; the
+reference's full-complex (dtype='c16') path for odd multipoles under
+wide-angle effects is not yet implemented.
+"""
+
+import logging
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...binned_statistic import BinnedStatistic
+from ...utils import JSONEncoder, JSONDecoder
+from ..fftpower import project_to_basis, _find_unique_edges
+from ...base.mesh import Field
+from .catalogmesh import FKPCatalogMesh
+from .catalog import FKPCatalog
+from ...ops.window import compensation_transfer
+
+
+def get_real_Ylm(l, m):
+    """A jnp-evaluable real spherical harmonic Y_lm(x, y, z) on unit
+    vectors (reference: sympy-generated at convpower/fkp.py:12-73).
+
+    Uses P_l^m(z) = (sin theta)^m W_lm(z) with the polynomial recurrence
+      W_mm = (-1)^m (2m-1)!!,  W_{m+1,m} = z (2m+1) W_mm,
+      W_lm = ((2l-1) z W_{l-1,m} - (l+m-1) W_{l-2,m}) / (l - m),
+    and (sin theta)^m cos/sin(m phi) = Re/Im[(x + i y)^m] — polynomial
+    in (x, y, z), hence pole-safe.
+    """
+    m_abs = abs(m)
+
+    # normalization sqrt((2l+1)/(4pi) (l-m)!/(l+m)!)
+    from math import factorial, sqrt, pi
+    norm = sqrt((2 * l + 1) / (4 * pi)
+                * factorial(l - m_abs) / factorial(l + m_abs))
+    if m != 0:
+        norm *= sqrt(2.0)
+
+    def Ylm(x, y, z):
+        # W_lm(z) by recurrence
+        Wmm = 1.0
+        for i in range(m_abs):
+            Wmm = -Wmm * (2 * i + 1)
+        W_prev = jnp.full_like(z, Wmm)
+        if l == m_abs:
+            W = W_prev
+        else:
+            W_cur = z * (2 * m_abs + 1) * Wmm
+            for ll in range(m_abs + 2, l + 1):
+                W_next = ((2 * ll - 1) * z * W_cur
+                          - (ll + m_abs - 1) * W_prev) / (ll - m_abs)
+                W_prev, W_cur = W_cur, W_next
+            W = W_cur if l > m_abs else W_prev
+        # azimuthal factor via complex powers
+        if m_abs == 0:
+            azim = 1.0
+        else:
+            re, im = x, y
+            for _ in range(m_abs - 1):
+                re, im = re * x - im * y, re * y + im * x
+            azim = re if m >= 0 else im
+        return norm * W * azim
+
+    Ylm.l = l
+    Ylm.m = m
+    return Ylm
+
+
+class ConvolvedFFTPower(object):
+    """Power-spectrum multipoles of an FKP-weighted survey catalog.
+
+    Parameters (reference convpower/fkp.py:134):
+    first : FKPCatalog or FKPCatalogMesh
+    poles : list of int multipoles
+    dk, kmin, kmax : k-binning
+    second : optional cross mesh (same FKPCatalog geometry)
+    """
+
+    logger = logging.getLogger('ConvolvedFFTPower')
+
+    def __init__(self, first, poles, second=None, Nmesh=None, kmin=0.,
+                 kmax=None, dk=None):
+        if isinstance(first, FKPCatalog):
+            first = first.to_mesh(Nmesh=Nmesh)
+        if not isinstance(first, FKPCatalogMesh):
+            raise TypeError("first must be an FKPCatalog or "
+                            "FKPCatalogMesh")
+        if second is None:
+            second = first
+        self.first = first
+        self.second = second
+        self.comm = first.comm
+
+        if np.isscalar(poles):
+            poles = [poles]
+        self.attrs = {
+            'poles': sorted(poles),
+            'dk': dk,
+            'kmin': kmin,
+            'kmax': kmax,
+        }
+        self.attrs['Nmesh'] = first.attrs['Nmesh'].copy()
+        self.attrs['BoxSize'] = first.attrs['BoxSize']
+        self.attrs['BoxCenter'] = first.attrs['BoxCenter']
+
+        self.run()
+
+    def run(self):
+        pm = self.first.pm
+        dk = 2 * np.pi / pm.BoxSize.min() if self.attrs['dk'] is None \
+            else self.attrs['dk']
+        kmin = self.attrs['kmin']
+        kmax = self.attrs['kmax']
+        if kmax is None:
+            kmax = np.pi * pm.Nmesh.min() / pm.BoxSize.max() + dk / 2
+
+        if dk > 0:
+            kedges = np.arange(kmin, kmax, dk)
+            kcoords = None
+        else:
+            kedges, kcoords = _find_unique_edges(pm, kmax)
+
+        result = self._compute_multipoles(kedges)
+
+        self.poles = BinnedStatistic(
+            ['k'], [kedges], result, fields_to_sum=['modes'],
+            coords=[kcoords], **self.attrs)
+        self.edges = kedges
+
+    def _compute_multipoles(self, kedges):
+        pm = self.first.pm
+        volume = float(np.prod(pm.BoxSize))
+
+        poles = sorted(self.attrs['poles'])
+        if 0 not in poles:
+            poles = [0] + poles
+
+        # the FKP density field
+        rfield1 = self.first.compute(Nmesh=self.attrs['Nmesh'],
+                                     mode='real')
+        meta1 = dict(rfield1.attrs)
+        self.attrs['alpha'] = meta1['alpha']
+
+        transfer = compensation_transfer(self.first.resampler,
+                                         self.first.interlaced)
+        w_circ = pm.k_list(circular=True)
+
+        c1 = pm.r2c(rfield1.value)
+        c1 = transfer(w_circ, c1)
+        A0_1 = c1 * volume
+
+        if self.first is not self.second:
+            rfield2 = self.second.compute(Nmesh=self.attrs['Nmesh'],
+                                          mode='real')
+            meta2 = dict(rfield2.attrs)
+            if not np.allclose(meta1['alpha'], meta2['alpha'],
+                               rtol=1e-3):
+                raise ValueError(
+                    "cross-correlations require the same FKPCatalog "
+                    "geometry (matching alpha)")
+            c2 = transfer(w_circ, pm.r2c(rfield2.value)) * volume
+            A0_2 = c2
+        else:
+            rfield2 = rfield1
+            meta2 = meta1
+            A0_2 = A0_1
+
+        # normalization & shot noise from catalog sums
+        for name in ['data', 'randoms']:
+            self.attrs[name + '.norm'] = self.normalization(
+                name, self.attrs['alpha'])
+        if self.attrs['randoms.norm'] > 0:
+            norm = 1.0 / self.attrs['randoms.norm']
+            Adata = self.attrs['data.norm']
+            Aran = self.attrs['randoms.norm']
+            if not np.allclose(Adata, Aran, rtol=0.05):
+                raise ValueError(
+                    "normalizations from data (%.6g) and randoms (%.6g) "
+                    "differ by more than 5%%; check the n(z) column "
+                    "normalization and FKP weights" % (Adata, Aran))
+        else:
+            norm = 1.0
+
+        # absolute-coordinate unit vectors on the mesh: cell centers
+        # shifted back to survey coordinates
+        N0, N1, N2 = pm.shape_real
+        H = pm.cellsize
+        offset = self.attrs['BoxCenter'] - pm.BoxSize / 2.0 + 0.5 * H
+
+        xh = [(jnp.arange(N0, dtype=jnp.float64) * H[0]
+               + offset[0]).reshape(N0, 1, 1),
+              (jnp.arange(N1, dtype=jnp.float64) * H[1]
+               + offset[1]).reshape(1, N1, 1),
+              (jnp.arange(N2, dtype=jnp.float64) * H[2]
+               + offset[2]).reshape(1, 1, N2)]
+        xnorm = jnp.sqrt(sum(x ** 2 for x in xh))
+        xnorm = jnp.where(xnorm == 0, 1.0, xnorm)
+        xh = [x / xnorm for x in xh]
+
+        kx, ky, kz = pm.k_list(dtype=jnp.float64)
+        knorm = jnp.sqrt(kx ** 2 + ky ** 2 + kz ** 2)
+        knorm = jnp.where(knorm == 0, jnp.inf, knorm)
+        kh = [kx / knorm, ky / knorm, kz / knorm]
+
+        cols = ['k'] + ['power_%d' % l for l in
+                        sorted(self.attrs['poles'])] + ['modes']
+        dtype = [('k', 'f8')] + [('power_%d' % l, 'c16') for l in
+                                 sorted(self.attrs['poles'])] + \
+            [('modes', 'i8')]
+        result = np.empty(len(kedges) - 1, dtype=np.dtype(dtype))
+
+        muedges = np.linspace(-1, 1, 2)
+        density2 = rfield2.value
+
+        def ell_term(ell):
+            """Aell = sum_m FFT[F * Ylm(xh)] * Ylm(kh), compensated,
+            * 4pi * volume — one jitted program per ell."""
+            Aell = jnp.zeros(pm.shape_complex,
+                             dtype=A0_1.dtype)
+            for m in range(-ell, ell + 1):
+                Ylm = get_real_Ylm(ell, m)
+                wx = Ylm(xh[0], xh[1], xh[2])
+                r = density2 * wx.astype(density2.dtype)
+                ck = pm.r2c(r)
+                wk = Ylm(kh[0], kh[1], kh[2])
+                Aell = Aell + ck * wk
+            Aell = transfer(w_circ, Aell)
+            return Aell * (4 * np.pi * volume)
+
+        proj_result = None
+        for ell in poles[1:]:
+            t0 = time.time()
+            Aell = jax.jit(ell_term, static_argnums=0)(ell)
+            p3d = norm * A0_1 * jnp.conj(Aell)
+            field = Field(p3d, pm, 'complex')
+            proj, _ = project_to_basis(field, [kedges, muedges])
+            result['power_%d' % ell][:] = np.squeeze(proj[2])
+            self.logger.info("ell = %d done (%d FFTs, %.2fs)"
+                             % (ell, 2 * ell + 1, time.time() - t0))
+            proj_result = proj
+
+        if 0 in self.attrs['poles']:
+            p3d = norm * A0_1 * jnp.conj(A0_2)
+            field = Field(p3d, pm, 'complex')
+            proj, _ = project_to_basis(field, [kedges, muedges])
+            result['power_0'][:] = np.squeeze(proj[2])
+            proj_result = proj
+
+        result['k'][:] = np.squeeze(proj_result[0])
+        result['modes'][:] = np.squeeze(proj_result[3])
+
+        self.attrs['shotnoise'] = self.shotnoise(self.attrs['alpha'])
+
+        for key in ['data.W', 'randoms.W', 'data.N', 'randoms.N',
+                    'data.num_per_cell', 'randoms.num_per_cell']:
+            if key in meta1:
+                self.attrs[key] = meta1[key]
+        return result
+
+    def normalization(self, name, alpha):
+        """A = sum n(z) w_comp w_fkp1 w_fkp2 (alpha-weighted for the
+        randoms); Beutler et al. 2014 eqs. 13-14 (reference :657-709)."""
+        mesh1, mesh2 = self.first, self.second
+        cat1 = mesh1.source[name]
+        cat2 = mesh2.source[name]
+        sel = jnp.asarray(cat1[mesh1.selection])
+        comp = cat1[mesh1.comp_weight]
+        nbar = cat2[mesh2.nbar]
+        w1 = cat1[mesh1.fkp_weight]
+        w2 = w1 if mesh1 is mesh2 else cat2[mesh2.fkp_weight]
+        A = jnp.where(sel, nbar * comp * w1 * w2, 0.0).sum()
+        A = float(A)
+        if name == 'randoms':
+            A *= alpha
+        return A
+
+    def shotnoise(self, alpha):
+        """S = [sum_data (w_comp w_fkp)^2 + alpha^2 sum_randoms (...)^2]
+        / randoms.norm (Beutler et al. 2014 eq. 15; reference
+        :711-759)."""
+        Pshot = 0.0
+        mesh1, mesh2 = self.first, self.second
+        for name in ['data', 'randoms']:
+            cat1 = mesh1.source[name]
+            cat2 = mesh2.source[name]
+            sel = jnp.asarray(cat1[mesh1.selection])
+            comp = cat1[mesh1.comp_weight]
+            w1 = cat1[mesh1.fkp_weight]
+            w2 = w1 if mesh1 is mesh2 else cat2[mesh2.fkp_weight]
+            S = float(jnp.where(sel, comp ** 2 * w1 * w2, 0.0).sum())
+            if name == 'randoms':
+                S *= alpha ** 2
+            Pshot += S
+        if self.attrs['randoms.norm'] > 0:
+            return Pshot / self.attrs['randoms.norm']
+        return 0.0
+
+    def to_pkmu(self, mu_edges, max_ell):
+        """Rotate multipoles into P(k, mu) wedges (reference :282)."""
+        from scipy.special import legendre
+        from scipy.integrate import quad
+
+        def coefficient(ell, mumin, mumax):
+            return quad(lambda mu: legendre(ell)(mu), mumin,
+                        mumax)[0] / (mumax - mumin)
+
+        ells = list(range(0, max_ell + 1, 2))
+        if any('power_%d' % ell not in self.poles for ell in ells):
+            raise ValueError("need all even ells <= %d" % max_ell)
+
+        dtype = np.dtype([('power', 'c8'), ('k', 'f8'), ('mu', 'f8')])
+        data = np.zeros((self.poles.shape[0], len(mu_edges) - 1),
+                        dtype=dtype)
+        for imu, (lo, hi) in enumerate(zip(mu_edges[:-1], mu_edges[1:])):
+            for ell in ells:
+                data['power'][:, imu] += coefficient(ell, lo, hi) \
+                    * self.poles['power_%d' % ell]
+            data['k'][:, imu] = self.poles['k']
+            data['mu'][:, imu] = 0.5 * (lo + hi)
+
+        return BinnedStatistic(
+            ['k', 'mu'], [self.poles.edges['k'], mu_edges], data,
+            coords=[self.poles.coords['k'], None], **self.attrs)
+
+    def save(self, output):
+        import json
+        with open(output, 'w') as ff:
+            json.dump(self.__getstate__(), ff, cls=JSONEncoder)
+
+    @classmethod
+    def load(cls, output, comm=None):
+        import json
+        with open(output, 'r') as ff:
+            state = json.load(ff, cls=JSONDecoder)
+        self = object.__new__(cls)
+        self.__setstate__(state)
+        return self
+
+    def __getstate__(self):
+        return dict(edges=self.edges,
+                    poles=self.poles.__getstate__(),
+                    attrs=self.attrs)
+
+    def __setstate__(self, state):
+        self.attrs = state['attrs']
+        self.edges = state['edges']
+        self.poles = BinnedStatistic.from_state(state['poles'])

@@ -146,13 +146,14 @@ class CatalogSourceBase(object):
         raise KeyError("invalid catalog selection %r" % (sel,))
 
     def view(self, type=None):
-        """A zero-copy re-typed view (reference base/catalog.py:727)."""
+        """A re-typed view sharing column *data* (reference
+        base/catalog.py:727). The column dicts are shallow-copied so
+        adding derived columns on the view does not pollute the base."""
         type = type or self.__class__
         obj = object.__new__(type)
-        obj.comm = self.comm
-        obj.attrs = self.attrs
-        obj._columns = self._columns
-        obj._cache = self._cache
+        obj.__dict__.update(self.__dict__)
+        obj._columns = dict(self._columns)
+        obj._cache = dict(self._cache)
         obj._size = len(self)
         obj.base = self
         return obj

@@ -20,3 +20,5 @@ from . import cosmology  # noqa: F401,E402
 from .cosmology import (Cosmology, Planck13, Planck15,  # noqa: F401,E402
                         WMAP5, WMAP7, WMAP9, LinearPower, HalofitPower,
                         ZeldovichPower, CorrelationFunction)
+from .algorithms import ConvolvedFFTPower, FKPCatalog, FKPWeightFromNbar  # noqa: F401,E402
+from .source.catalog.species import MultipleSpeciesCatalog  # noqa: F401,E402

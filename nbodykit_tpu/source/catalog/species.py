@@ -1,0 +1,92 @@
+"""MultipleSpeciesCatalog: several catalogs under one namespace.
+
+Reference: ``nbodykit/source/catalog/species.py:9``. Columns are
+addressed as ``"<species>/<column>"``; ``cat[species]`` returns the
+underlying catalog (a view, so column assignment propagates).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...base.catalog import CatalogSourceBase
+
+
+class MultipleSpeciesCatalog(CatalogSourceBase):
+    """A container of named CatalogSource species.
+
+    Parameters
+    ----------
+    names : list of str — species names (no '/' allowed)
+    *species : the catalogs, same length as names
+    """
+
+    def __init__(self, names, *species, **kwargs):
+        if len(set(names)) != len(names):
+            raise ValueError("species names must be unique")
+        if len(names) != len(species):
+            raise ValueError("need one name per species catalog")
+        if any('/' in name for name in names):
+            raise ValueError("species names cannot contain '/'")
+
+        CatalogSourceBase.__init__(self, comm=species[0].comm)
+        self.attrs['species'] = list(names)
+        self._species = dict(zip(names, species))
+
+        # species attrs are namespaced into the container attrs
+        for name, cat in self._species.items():
+            for k, v in cat.attrs.items():
+                self.attrs['%s.%s' % (name, k)] = v
+
+    @property
+    def species(self):
+        return self.attrs['species']
+
+    @property
+    def columns(self):
+        out = []
+        for name in self.species:
+            out += ['%s/%s' % (name, col)
+                    for col in self._species[name].columns]
+        return sorted(out)
+
+    def __len__(self):
+        return sum(len(self._species[name]) for name in self.species)
+
+    @property
+    def csize(self):
+        return len(self)
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            if key in self.species:
+                return self._species[key]
+            if '/' in key:
+                name, col = key.split('/', 1)
+                if name not in self.species:
+                    raise KeyError("no species named %r" % name)
+                return self._species[name][col]
+        raise KeyError("column spec %r; use 'species/column' or a "
+                       "species name" % (key,))
+
+    def __setitem__(self, key, value):
+        if '/' not in key:
+            raise ValueError("set columns as 'species/column'")
+        name, col = key.split('/', 1)
+        self._species[name][col] = value
+
+    def to_mesh(self, Nmesh=None, BoxSize=None, dtype='f4',
+                interlaced=False, compensated=False, resampler='cic',
+                position='Position', weight='Weight', value='Value',
+                selection='Selection'):
+        from ..mesh.species import MultipleSpeciesCatalogMesh
+        if Nmesh is None:
+            Nmesh = self.attrs.get('Nmesh', None)
+        if BoxSize is None:
+            BoxSize = self.attrs.get('BoxSize', None)
+        if Nmesh is None or BoxSize is None:
+            raise ValueError("pass Nmesh and BoxSize to to_mesh")
+        return MultipleSpeciesCatalogMesh(
+            self, Nmesh=Nmesh, BoxSize=BoxSize, dtype=dtype,
+            interlaced=interlaced, compensated=compensated,
+            resampler=resampler, position=position, weight=weight,
+            value=value, selection=selection)

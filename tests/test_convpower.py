@@ -1,0 +1,133 @@
+"""ConvolvedFFTPower / FKPCatalog tests (reference analog:
+algorithms/convpower/tests/): Ylm addition theorem, periodic-box
+consistency oracle, normalization/shotnoise identities, to_pkmu,
+save/load.
+"""
+
+import numpy as np
+import pytest
+
+from nbodykit_tpu.lab import (UniformCatalog, LogNormalCatalog,
+                              LinearPower, Planck15, FFTPower,
+                              ConvolvedFFTPower, FKPCatalog,
+                              FKPWeightFromNbar)
+from nbodykit_tpu.algorithms.convpower import get_real_Ylm
+
+
+def test_real_ylm_addition_theorem():
+    # sum_m Ylm(a) Ylm(b) == (2l+1)/(4pi) P_l(a.b)
+    rng = np.random.RandomState(0)
+    a = rng.standard_normal(3)
+    a /= np.linalg.norm(a)
+    b = rng.standard_normal(3)
+    b /= np.linalg.norm(b)
+    from numpy.polynomial.legendre import legval
+    for ell in [1, 2, 3, 4]:
+        total = sum(
+            float(get_real_Ylm(ell, m)(a[0], a[1], a[2]))
+            * float(get_real_Ylm(ell, m)(b[0], b[1], b[2]))
+            for m in range(-ell, ell + 1))
+        coeffs = np.zeros(ell + 1)
+        coeffs[ell] = 1.0
+        want = (2 * ell + 1) / (4 * np.pi) * legval(float(a @ b), coeffs)
+        np.testing.assert_allclose(total, want, rtol=1e-10)
+
+
+def test_real_ylm_orthonormal():
+    # numerical quadrature of Ylm * Yl'm' over the sphere
+    nth, nph = 128, 256
+    theta = (np.arange(nth) + 0.5) * np.pi / nth
+    phi = (np.arange(nph) + 0.5) * 2 * np.pi / nph
+    T, P = np.meshgrid(theta, phi, indexing='ij')
+    x = np.sin(T) * np.cos(P)
+    y = np.sin(T) * np.sin(P)
+    z = np.cos(T)
+    dA = np.sin(T) * (np.pi / nth) * (2 * np.pi / nph)
+    y22 = np.asarray(get_real_Ylm(2, 2)(x, y, z))
+    y20 = np.asarray(get_real_Ylm(2, 0)(x, y, z))
+    np.testing.assert_allclose((y22 ** 2 * dA).sum(), 1.0, rtol=1e-3)
+    np.testing.assert_allclose((y20 ** 2 * dA).sum(), 1.0, rtol=1e-3)
+    assert abs((y22 * y20 * dA).sum()) < 1e-10
+
+
+@pytest.fixture(scope='module')
+def fkp_setup():
+    Plin = LinearPower(Planck15, 0.55)
+    Plin.sigma8 = 0.8
+    data = LogNormalCatalog(Plin=Plin, nbar=5e-4, BoxSize=256., Nmesh=32,
+                            bias=2.0, seed=11)
+    ran = UniformCatalog(nbar=5e-3, BoxSize=256., seed=12)
+    nbar_val = data.csize / 256. ** 3
+    data['NZ'] = np.ones(data.csize) * nbar_val
+    ran['NZ'] = np.ones(ran.csize) * nbar_val
+    fkp = FKPCatalog(data, ran, BoxSize=270.0)
+    mesh = fkp.to_mesh(Nmesh=32, resampler='cic', compensated=True)
+    r = ConvolvedFFTPower(mesh, poles=[0, 2, 4], dk=0.02, kmin=0.02)
+    return Plin, data, r
+
+
+def test_convpower_periodic_consistency(fkp_setup):
+    Plin, data, r = fkp_setup
+    # full-box "survey" with constant n(z): P0 should track the
+    # periodic-box FFTPower at the 30% level (window + noise)
+    p0 = r.poles['power_0'].real - r.attrs['shotnoise']
+    k = r.poles['k']
+    mesh = data.to_mesh(Nmesh=32, BoxSize=256., resampler='cic',
+                        compensated=True)
+    rp = FFTPower(mesh, mode='1d', dk=0.02, kmin=0.02)
+    pk_per = np.interp(k, rp.power['k'],
+                       rp.power['power'].real - rp.attrs['shotnoise'])
+    sel = (k > 0.05) & (k < 0.3)
+    ratio = p0[sel] / pk_per[sel]
+    assert abs(np.nanmean(ratio) - 1) < 0.3
+
+
+def test_convpower_attrs(fkp_setup):
+    _, data, r = fkp_setup
+    # alpha ~ 1/10 by construction
+    assert abs(r.attrs['alpha'] - 0.1) < 0.02
+    # norms from data and randoms agree to 5% (enforced) and shotnoise
+    # is near the V/N level
+    assert abs(r.attrs['data.norm'] / r.attrs['randoms.norm'] - 1) < 0.05
+    assert r.attrs['shotnoise'] > 0
+
+
+def test_convpower_to_pkmu(fkp_setup):
+    _, _, r = fkp_setup
+    pkmu = r.to_pkmu(np.linspace(0, 1, 5), max_ell=4)
+    assert pkmu.shape == (len(r.poles['k']), 4)
+    # the mu-average of wedges reproduces the monopole
+    recon = np.nanmean(pkmu['power'].real, axis=-1)
+    valid = ~np.isnan(recon)
+    np.testing.assert_allclose(recon[valid],
+                               r.poles['power_0'].real[valid], rtol=0.15)
+
+
+def test_convpower_save_load(fkp_setup, tmp_path):
+    _, _, r = fkp_setup
+    fn = str(tmp_path / "conv.json")
+    r.save(fn)
+    r2 = ConvolvedFFTPower.load(fn)
+    np.testing.assert_allclose(r.poles['power_0'].real,
+                               r2.poles['power_0'].real, equal_nan=True)
+    assert r2.attrs['alpha'] == r.attrs['alpha']
+
+
+def test_fkp_weight():
+    nbar = np.array([1e-4, 1e-3])
+    w = FKPWeightFromNbar(1e4, nbar)
+    np.testing.assert_allclose(w, 1.0 / (1 + 1e4 * nbar))
+    assert FKPWeightFromNbar(0, nbar) == 1.0
+
+
+def test_multiple_species_basic():
+    from nbodykit_tpu.lab import MultipleSpeciesCatalog
+    c1 = UniformCatalog(nbar=1e-4, BoxSize=100., seed=1)
+    c2 = UniformCatalog(nbar=1e-4, BoxSize=100., seed=2)
+    cat = MultipleSpeciesCatalog(['a', 'b'], c1, c2)
+    assert cat.csize == c1.csize + c2.csize
+    assert 'a/Position' in cat.columns
+    np.testing.assert_allclose(np.asarray(cat['a/Position']),
+                               np.asarray(c1['Position']))
+    cat['a/Extra'] = np.ones(c1.csize)
+    assert 'Extra' in c1.columns
