@@ -4,7 +4,8 @@ finders, pair counting, and histograms."""
 from .fftpower import FFTPower, ProjectedFFTPower, FFTBase, project_to_basis
 from .fftcorr import FFTCorr
 from .convpower import ConvolvedFFTPower, FKPCatalog, FKPWeightFromNbar
+from .fftrecon import FFTRecon
 
 __all__ = ['FFTPower', 'ProjectedFFTPower', 'FFTBase', 'FFTCorr',
-           'ConvolvedFFTPower', 'FKPCatalog', 'FKPWeightFromNbar',
+           'ConvolvedFFTPower', 'FKPCatalog', 'FKPWeightFromNbar', 'FFTRecon',
            'project_to_basis']

@@ -1,0 +1,143 @@
+"""FFTRecon: standard BAO reconstruction of the density field.
+
+Reference: ``nbodykit/algorithms/fftrecon.py:11``. Capability parity:
+LGS (Lagrangian growth shift), LF2, and LRR schemes; RSD reversion via
+(bias, f, los); Gaussian smoothing of the displacement solve.
+
+TPU redesign: the displacement solve (paint -> r2c -> smoothed
+1j k / k^2 kernel -> c2r -> readout) is jnp ops over the sharded mesh;
+the three component solves share one forward FFT.
+"""
+
+import logging
+import warnings
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base.mesh import MeshSource, Field
+from ..base.catalog import CatalogSourceBase
+from ..pmesh import ParticleMesh
+
+
+class FFTRecon(MeshSource):
+    """Reconstructed density mesh from data + randoms catalogs.
+
+    Parameters (reference fftrecon.py:24-62): data, ran, Nmesh, bias, f,
+    los, R (smoothing radius), position column, revert_rsd_random,
+    scheme in {'LGS', 'LF2', 'LRR'}, BoxSize.
+    """
+
+    logger = logging.getLogger('FFTRecon')
+
+    def __init__(self, data, ran, Nmesh, bias=1.0, f=0.0, los=[0, 0, 1],
+                 R=20, position='Position', revert_rsd_random=False,
+                 scheme='LGS', BoxSize=None, resampler='cic'):
+        if scheme not in ('LGS', 'LF2', 'LRR'):
+            raise ValueError("scheme must be LGS, LF2 or LRR")
+        if not isinstance(data, CatalogSourceBase) or \
+                not isinstance(ran, CatalogSourceBase):
+            raise TypeError("data and ran must be catalogs")
+
+        if Nmesh is None:
+            Nmesh = data.attrs['Nmesh']
+        if BoxSize is None:
+            BoxSize = data.attrs['BoxSize']
+
+        los = np.array(los, dtype='f8')
+        los /= (los ** 2).sum() ** 0.5
+
+        MeshSource.__init__(self, Nmesh, BoxSize, dtype='f4',
+                            comm=data.comm)
+        if (self.pm.BoxSize / self.pm.Nmesh).max() > R:
+            warnings.warn("smoothing radius is smaller than the mesh "
+                          "cell; expect numerical noise")
+
+        self.attrs.update(bias=bias, f=f, los=los, R=R, scheme=scheme,
+                          revert_rsd_random=bool(revert_rsd_random))
+        self.data = data
+        self.ran = ran
+        self.position = position
+        self.resampler = resampler
+
+    def to_real_field(self):
+        return self.run()
+
+    def run(self):
+        s_d, s_r = self._compute_s()
+        return self._helper_paint(s_d, s_r)
+
+    def _paint_overdensity(self, cat, shift):
+        """Paint cat at (Position - shift), normalized by mean density
+        (reference work_with, fftrecon.py:144-169)."""
+        pm = self.pm
+        pos = cat[self.position].astype(jnp.float32)
+        if shift is not None:
+            pos = pos - shift
+        field = pm.paint(pos, 1.0, resampler=self.resampler)
+        nbar = cat.csize / pm.Ntot
+        return field / nbar
+
+    def _displacement_kernels(self):
+        """The three smoothed Zel'dovich solve kernels
+        1j k_d / k^2 * exp(-k^2 R^2 / 2) / (b (1 + f/b mu^2))."""
+        pm = self.pm
+        kx, ky, kz = pm.k_list()
+        k2 = kx ** 2 + ky ** 2 + kz ** 2
+        k2s = jnp.where(k2 == 0, 1.0, k2)
+        los = self.attrs['los']
+        mu = (kx * los[0] + ky * los[1] + kz * los[2]) / jnp.sqrt(k2s)
+        smooth = jnp.exp(-0.5 * k2s * self.attrs['R'] ** 2)
+        frac = self.attrs['bias'] * (
+            1.0 + self.attrs['f'] / self.attrs['bias'] * mu ** 2)
+        base = smooth / frac
+        ks = [kx, ky, kz]
+        return [jnp.where(k2 == 0, 0.0, 1j * ks[d] / k2s * base)
+                for d in range(3)]
+
+    def _compute_s(self):
+        pm = self.pm
+        delta_d = self._paint_overdensity(self.data, None)
+        delta_k = pm.r2c(delta_d)
+        kernels = self._displacement_kernels()
+
+        def solve(cat):
+            pos = cat[self.position].astype(jnp.float32)
+            comps = []
+            for d in range(3):
+                disp = pm.c2r(delta_k * kernels[d])
+                comps.append(pm.readout(disp, pos,
+                                        resampler=self.resampler))
+            return jnp.stack(comps, axis=-1)
+
+        s_d = solve(self.data)
+        s_r = solve(self.ran)
+
+        los = jnp.asarray(self.attrs['los'], s_d.dtype)
+        # revert RSD in the data displacement (reference :260)
+        s_d = s_d * (1.0 + los * self.attrs['f'])
+        if self.attrs['revert_rsd_random']:
+            s_r = s_r * (1.0 + los * self.attrs['f'])
+        return s_d, s_r
+
+    def _helper_paint(self, s_d, s_r):
+        """Combine shifted paints per scheme (reference :172-215)."""
+        delta_s_r = self._paint_overdensity(self.ran, s_r)
+
+        def LGS():
+            delta_s_d = self._paint_overdensity(self.data, s_d)
+            return delta_s_d - delta_s_r
+
+        def LRR():
+            delta_s_nr = self._paint_overdensity(self.ran, -s_r)
+            delta_d = self._paint_overdensity(self.data, None)
+            return delta_d - 0.5 * (delta_s_nr + delta_s_r)
+
+        if self.attrs['scheme'] == 'LGS':
+            out = LGS()
+        elif self.attrs['scheme'] == 'LRR':
+            out = LRR()
+        else:  # LF2
+            out = 3.0 / 7.0 * LGS() + 4.0 / 7.0 * LRR()
+
+        return Field(out, self.pm, 'real')

@@ -1,0 +1,22 @@
+"""IO layer: partitioned, column-addressable file readers + the bigfile
+store (SURVEY.md §2 'IO layer'; reference nbodykit/io/).
+
+Every reader implements the FileType contract
+(``read(columns, start, stop)`` -> structured numpy array), so catalogs
+can stream any format into device arrays; multi-file datasets compose
+with FileStack.
+"""
+
+from .base import FileType
+from .stack import FileStack
+from .binary import BinaryFile
+from .csv import CSVFile
+from .bigfile import BigFile, BigFileWriter
+from .hdf import HDFFile
+from .fits import FITSFile
+from .tpm import TPMBinaryFile
+from .gadget import Gadget1File
+
+__all__ = ['FileType', 'FileStack', 'BinaryFile', 'CSVFile', 'BigFile',
+           'BigFileWriter', 'HDFFile', 'FITSFile', 'TPMBinaryFile',
+           'Gadget1File']

@@ -1,0 +1,58 @@
+"""FileType: the abstract partitioned-read contract.
+
+Reference: ``nbodykit/io/base.py:7`` — a file exposes ``size``,
+``dtype`` (structured), ``ncol``/``shape`` and
+``read(columns, start, stop, step)`` returning a structured array.
+The reference wraps files as dask arrays (``get_dask``); here catalogs
+read slices directly into device arrays.
+"""
+
+import numpy as np
+
+
+class FileType(object):
+    """Abstract base for column-addressable partitioned file readers."""
+
+    # subclasses set in __init__:
+    size = None        # number of rows
+    dtype = None       # numpy structured dtype
+
+    def read(self, columns, start, stop, step=1):
+        raise NotImplementedError
+
+    @property
+    def columns(self):
+        return list(self.dtype.names)
+
+    @property
+    def shape(self):
+        return (self.size,)
+
+    @property
+    def ncol(self):
+        return len(self.dtype.names)
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, sel):
+        if isinstance(sel, str):
+            return self.read([sel], 0, self.size)[sel]
+        if isinstance(sel, slice):
+            start, stop, step = sel.indices(self.size)
+            return self.read(self.columns, start, stop, step)
+        raise KeyError(sel)
+
+    def keys(self):
+        return self.columns
+
+    def _empty(self, columns, n):
+        dt = np.dtype([(c, self.dtype[c]) for c in columns])
+        return np.empty(n, dtype=dt)
+
+    def asarray(self):
+        return self
+
+    def __repr__(self):
+        return "%s(size=%d, ncol=%d)" % (self.__class__.__name__,
+                                         self.size or 0, self.ncol)

@@ -1,0 +1,167 @@
+"""The column-store used for catalog/mesh persistence.
+
+Reference capability: ``nbodykit/io/bigfile.py:16`` (reader) and the
+bigfile C library (SURVEY.md §2.3) used for ``CatalogSource.save``
+(base/catalog.py:562-703) and mesh save (base/mesh.py:367-412).
+
+On-disk layout (plain files; self-describing; written/read in pure
+numpy — no C dependency):
+
+    <root>/
+      <dataset>/            one directory per column ("block")
+        header.json         {"dtype": "<f8", "shape": [N, ...], "nfile": K}
+        000000.bin ...      raw little-endian binary chunks
+      <header>/attrs.json   dataset attributes (numpy-aware JSON)
+
+This is bigfile-in-spirit (block-per-column, chunked plain binary,
+plain-text header); the header encoding is JSON rather than the C
+library's text format.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .base import FileType
+from ..utils import JSONEncoder, JSONDecoder
+
+
+class BigFileWriter(object):
+    """Writer for the block column store."""
+
+    def __init__(self, path, create=True):
+        self.path = path
+        if create:
+            os.makedirs(path, exist_ok=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *args):
+        pass
+
+    def write(self, dataset, array, attrs=None, nfile=1):
+        """Write one column (any-dimensional numpy array) as a block."""
+        array = np.ascontiguousarray(array)
+        bdir = os.path.join(self.path, dataset)
+        os.makedirs(bdir, exist_ok=True)
+        header = {
+            'dtype': array.dtype.str,
+            'shape': list(array.shape),
+            'nfile': nfile,
+        }
+        with open(os.path.join(bdir, 'header.json'), 'w') as ff:
+            json.dump(header, ff)
+        flat = array.reshape(array.shape[0], -1) if array.ndim else array
+        bounds = np.linspace(0, len(array), nfile + 1).astype(int)
+        for i, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:])):
+            with open(os.path.join(bdir, '%06d.bin' % i), 'wb') as ff:
+                array[lo:hi].tofile(ff)
+        if attrs:
+            self.write_attrs(dataset, attrs, merge=True)
+
+    def write_attrs(self, dataset, attrs, merge=False):
+        bdir = os.path.join(self.path, dataset)
+        os.makedirs(bdir, exist_ok=True)
+        fn = os.path.join(bdir, 'attrs.json')
+        out = {}
+        if merge and os.path.exists(fn):
+            with open(fn) as ff:
+                out = json.load(ff, cls=JSONDecoder)
+        out.update(attrs)
+        with open(fn, 'w') as ff:
+            json.dump(out, ff, cls=JSONEncoder)
+
+
+class BigFileDataset(object):
+    """A single on-disk block (column)."""
+
+    def __init__(self, root, name):
+        self.dir = os.path.join(root, name)
+        with open(os.path.join(self.dir, 'header.json')) as ff:
+            h = json.load(ff)
+        self.dtype = np.dtype(h['dtype'])
+        self.shape = tuple(h['shape'])
+        self.nfile = h['nfile']
+        n = self.shape[0] if self.shape else 0
+        self.bounds = np.linspace(0, n, self.nfile + 1).astype(int)
+
+    @property
+    def size(self):
+        return self.shape[0]
+
+    def read(self, start, stop):
+        itemshape = self.shape[1:]
+        nper = int(np.prod(itemshape, dtype=int))
+        out = np.empty((stop - start,) + itemshape, dtype=self.dtype)
+        for i in range(self.nfile):
+            lo, hi = self.bounds[i], self.bounds[i + 1]
+            s = max(start, lo)
+            e = min(stop, hi)
+            if s >= e:
+                continue
+            fn = os.path.join(self.dir, '%06d.bin' % i)
+            with open(fn, 'rb') as ff:
+                ff.seek((s - lo) * self.dtype.itemsize * nper)
+                data = np.fromfile(ff, dtype=self.dtype,
+                                   count=(e - s) * nper)
+            out[s - start:e - start] = data.reshape((e - s,) + itemshape)
+        return out
+
+
+class BigFile(FileType):
+    """Reader exposing the FileType contract over a block store
+    (reference: nbodykit/io/bigfile.py:16 with ``dataset`` and
+    ``exclude`` semantics)."""
+
+    def __init__(self, path, exclude=None, header='Header', dataset='./'):
+        self.path = path
+        self.dataset = dataset.rstrip('/')
+        root = os.path.join(path, self.dataset) if self.dataset not in \
+            ('.', '') else path
+        self.root = root
+
+        if exclude is None:
+            exclude = [header, 'Header', 'attrs.json']
+        blocks = []
+        for name in sorted(os.listdir(root)):
+            bdir = os.path.join(root, name)
+            if not os.path.isdir(bdir):
+                continue
+            if name in exclude:
+                continue
+            if os.path.exists(os.path.join(bdir, 'header.json')):
+                blocks.append(name)
+        if not blocks:
+            raise ValueError("no data blocks found under %s" % root)
+
+        self._blocks = {name: BigFileDataset(root, name)
+                        for name in blocks}
+        sizes = {name: b.size for name, b in self._blocks.items()}
+        if len(set(sizes.values())) > 1:
+            raise ValueError("column size mismatch: %s" % sizes)
+        self.size = next(iter(sizes.values()))
+
+        dt = []
+        for name in blocks:
+            b = self._blocks[name]
+            itemshape = b.shape[1:]
+            dt.append((name, b.dtype, itemshape) if itemshape
+                      else (name, b.dtype))
+        self.dtype = np.dtype(dt)
+
+        # attrs from the header dataset
+        self.attrs = {}
+        for hdr in [header, 'Header']:
+            fn = os.path.join(root, hdr, 'attrs.json')
+            if os.path.exists(fn):
+                with open(fn) as ff:
+                    self.attrs = json.load(ff, cls=JSONDecoder)
+                break
+
+    def read(self, columns, start, stop, step=1):
+        out = self._empty(columns, (stop - start + step - 1) // step)
+        for col in columns:
+            out[col] = self._blocks[col].read(start, stop)[::step]
+        return out

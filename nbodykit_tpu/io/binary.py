@@ -1,0 +1,63 @@
+"""BinaryFile: columns appended in a single binary file.
+
+Reference: ``nbodykit/io/binary.py:43`` — a flat binary file holding
+columns of fixed dtype one after another (with optional header offsets).
+"""
+
+import os
+
+import numpy as np
+
+from .base import FileType
+
+
+class BinaryFile(FileType):
+    """Column-appended binary file.
+
+    Parameters
+    ----------
+    path : file path
+    dtype : list of (name, dtype[, itemshape]) — column layout, in file
+        order
+    offsets : optional dict of column -> byte offset; default assumes
+        columns stored back-to-back after ``header_size`` bytes
+    header_size : bytes to skip at the start
+    size : number of rows; inferred from the file size when None
+    """
+
+    def __init__(self, path, dtype, offsets=None, header_size=0,
+                 size=None):
+        self.path = path
+        self.dtype = np.dtype(dtype)
+        fsize = os.path.getsize(path)
+
+        if size is None:
+            size = (fsize - header_size) // self.dtype.itemsize
+        self.size = int(size)
+
+        if offsets is None:
+            offsets = {}
+            off = header_size
+            for name in self.dtype.names:
+                offsets[name] = off
+                sub = self.dtype[name]
+                off += sub.itemsize * self.size
+            if off > fsize:
+                raise ValueError(
+                    "file too small: need %d bytes for %d rows, have %d"
+                    % (off, self.size, fsize))
+        self.offsets = offsets
+
+    def read(self, columns, start, stop, step=1):
+        out = self._empty(columns, len(range(start, stop, step)))
+        with open(self.path, 'rb') as ff:
+            for col in columns:
+                sub = self.dtype[col]
+                ff.seek(self.offsets[col] + start * sub.itemsize)
+                data = np.fromfile(
+                    ff, dtype=sub.base,
+                    count=(stop - start) * int(np.prod(sub.shape,
+                                                       dtype=int)))
+                data = data.reshape((stop - start,) + sub.shape)
+                out[col] = data[::step]
+        return out

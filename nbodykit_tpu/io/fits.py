@@ -1,0 +1,49 @@
+"""FITSFile: FITS binary-table reads.
+
+Reference: ``nbodykit/io/fits.py:8`` (fitsio-backed). fitsio is not in
+this environment; astropy.io.fits is used when available, else a clear
+ImportError at construction.
+"""
+
+import numpy as np
+
+from .base import FileType
+
+
+class FITSFile(FileType):
+    """FITS binary table reader (ext selects the HDU)."""
+
+    def __init__(self, path, ext=None):
+        try:
+            from astropy.io import fits
+        except ImportError:
+            try:
+                import fitsio  # noqa: F401
+            except ImportError:
+                raise ImportError(
+                    "reading FITS requires astropy or fitsio; neither "
+                    "is available in this environment")
+        self.path = path
+        with fits.open(path) as hdus:
+            if ext is None:
+                for i, hdu in enumerate(hdus):
+                    if getattr(hdu, 'data', None) is not None and \
+                            getattr(hdu, 'columns', None) is not None:
+                        ext = i
+                        break
+            if ext is None:
+                raise ValueError("no binary table HDU found")
+            self.ext = ext
+            data = hdus[ext].data
+            self.size = len(data)
+            self.dtype = data.dtype
+            self.attrs = dict(hdus[ext].header)
+
+    def read(self, columns, start, stop, step=1):
+        from astropy.io import fits
+        out = self._empty(columns, len(range(start, stop, step)))
+        with fits.open(self.path) as hdus:
+            data = hdus[self.ext].data[start:stop:step]
+            for col in columns:
+                out[col] = data[col]
+        return out

@@ -22,3 +22,9 @@ from .cosmology import (Cosmology, Planck13, Planck15,  # noqa: F401,E402
                         ZeldovichPower, CorrelationFunction)
 from .algorithms import ConvolvedFFTPower, FKPCatalog, FKPWeightFromNbar  # noqa: F401,E402
 from .source.catalog.species import MultipleSpeciesCatalog  # noqa: F401,E402
+from .source.catalog.file import (CSVCatalog, BinaryCatalog,  # noqa: F401,E402
+                                  BigFileCatalog, HDFCatalog, FITSCatalog,
+                                  TPMBinaryCatalog, Gadget1Catalog)
+from .source.mesh.bigfile import BigFileMesh  # noqa: F401,E402
+from .algorithms.fftrecon import FFTRecon  # noqa: F401,E402
+from . import io  # noqa: F401,E402
