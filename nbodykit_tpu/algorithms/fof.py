@@ -1,0 +1,284 @@
+"""FOF: friends-of-friends halo finder.
+
+Reference: ``nbodykit/algorithms/fof.py:10`` — domain-decomposed kdcount
+FOF + iterative cross-rank label merging (:289-337), then halo property
+reduction (:427-727).
+
+TPU redesign (no kd-tree, no ragged recursion): a *grid-hash
+label-propagation* FOF that is one jitted XLA program:
+
+1. hash particles to cells of size = linking length; sort by cell
+   (cells are contiguous ranges after the sort);
+2. labels start as particle indices; each sweep takes, for every
+   particle, the min label over all particles of the 27 neighbor cells
+   within the linking length (fixed per-cell capacity K = max occupancy,
+   so shapes are static), followed by pointer-jumping (path halving),
+   inside a lax.while_loop until a fixpoint;
+3. halo properties (Length, periodic-aware CMPosition, CMVelocity) are
+   segment reductions over the final labels; halos are relabeled by
+   descending size with label 0 = below ``nmin`` (matching the
+   reference's _assign_labels ordering semantics, :197-287).
+
+The sweep cost is N * 27 * K distance checks, fully vectorized; the
+while_loop converges in O(log diameter) sweeps thanks to path halving.
+"""
+
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base.catalog import CatalogSourceBase
+from ..utils import as_numpy
+
+
+def _fof_labels(pos, BoxSize, ll, K):
+    """Jittable FOF label computation.
+
+    pos : (N, 3) positions; BoxSize : (3,) floats; ll : linking length
+    K : static per-cell capacity (max occupancy)
+
+    Returns (N,) int32 root labels (min particle index per group, in the
+    cell-sorted ordering) mapped back to input order.
+    """
+    N = pos.shape[0]
+    box = jnp.asarray(BoxSize, pos.dtype)
+    ncell = np.maximum(np.asarray(BoxSize) / ll, 3.0).astype('i8')
+    ncell = jnp.asarray(ncell, jnp.int32)
+    cellsize = box / ncell
+
+    ci = jnp.clip((pos / cellsize).astype(jnp.int32), 0, ncell - 1)
+    flat = (ci[:, 0] * ncell[1] + ci[:, 1]) * ncell[2] + ci[:, 2]
+    ncells_tot = int(np.prod(np.maximum(np.asarray(BoxSize) / ll, 3.0)
+                             .astype('i8')))
+
+    order = jnp.argsort(flat)
+    flat_s = flat[order]
+    pos_s = pos[order]
+
+    # cell -> [start, end) ranges in the sorted order
+    start = jnp.searchsorted(flat_s, jnp.arange(ncells_tot,
+                                                dtype=flat_s.dtype))
+    count = jnp.searchsorted(flat_s, jnp.arange(ncells_tot,
+                                                dtype=flat_s.dtype),
+                             side='right') - start
+
+    # neighbor cells (27 offsets, periodic)
+    offs = jnp.asarray([(i, j, k) for i in (-1, 0, 1)
+                        for j in (-1, 0, 1) for k in (-1, 0, 1)],
+                       dtype=jnp.int32)
+    ci_s = ci[order]
+
+    ll2 = jnp.asarray(ll * ll, pos.dtype)
+
+    def neighbor_min(labels):
+        """For each particle: min label among particles within ll."""
+        best = labels
+        for oi in range(27):
+            nc = jnp.mod(ci_s + offs[oi], ncell)
+            nflat = (nc[:, 0] * ncell[1] + nc[:, 1]) * ncell[2] + nc[:, 2]
+            s = start[nflat]
+            c = count[nflat]
+            for slot in range(K):
+                j = s + slot
+                valid = slot < c
+                j = jnp.where(valid, j, 0)
+                d = pos_s - pos_s[j]
+                d = d - jnp.round(d / box) * box  # periodic
+                r2 = jnp.sum(d * d, axis=-1)
+                ok = valid & (r2 <= ll2)
+                cand = jnp.where(ok, labels[j], best)
+                best = jnp.minimum(best, cand)
+        return best
+
+    labels0 = jnp.arange(N, dtype=jnp.int32)
+
+    def body(state):
+        labels, _ = state
+        new = neighbor_min(labels)
+        # pointer jumping (path halving) — labels are particle indices
+        new = jnp.minimum(new, new[new])
+        new = jnp.minimum(new, new[new])
+        changed = jnp.any(new != labels)
+        return new, changed
+
+    def cond(state):
+        return state[1]
+
+    labels, _ = jax.lax.while_loop(
+        cond, body, (labels0, jnp.asarray(True)))
+
+    # map back to input order: label value refers to sorted index; remap
+    # to a stable id = original index of the root particle
+    root_orig = order[labels]
+    out = jnp.empty(N, dtype=jnp.int32).at[order].set(
+        root_orig.astype(jnp.int32))
+    return out
+
+
+class FOF(object):
+    """Friends-of-friends groups of a CatalogSource.
+
+    Parameters (reference fof.py:46): source, linking_length (in mean
+    inter-particle separation units unless ``absolute=True``), nmin
+    (minimum group size), periodic.
+
+    Attributes
+    ----------
+    labels : (N,) halo label per particle; 0 = not in a group of size
+        >= nmin; halos ordered by descending size (label 1 is the
+        largest), matching the reference's convention.
+    """
+
+    logger = logging.getLogger('FOF')
+
+    def __init__(self, source, linking_length, nmin, absolute=False,
+                 periodic=True):
+        if 'Position' not in source:
+            raise ValueError("source must have a Position column")
+        self.comm = source.comm
+        self._source = source
+        self.attrs = {
+            'linking_length': linking_length,
+            'nmin': nmin,
+            'absolute': absolute,
+            'periodic': periodic,
+        }
+        if 'BoxSize' in source.attrs:
+            self.attrs['BoxSize'] = np.ones(3) * np.asarray(
+                source.attrs['BoxSize'], dtype='f8')
+        else:
+            raise ValueError("source must define attrs['BoxSize']")
+
+        if not absolute:
+            mean_sep = (np.prod(self.attrs['BoxSize'])
+                        / source.csize) ** (1. / 3)
+            linking_length = linking_length * mean_sep
+        self._ll = float(linking_length)
+
+        self.labels = self.run()
+
+    def run(self):
+        pos = self._source['Position']
+        BoxSize = self.attrs['BoxSize']
+
+        # static per-cell capacity from the data (eager host computation)
+        ncell = np.maximum(BoxSize / self._ll, 3.0).astype('i8')
+        cellsize = BoxSize / ncell
+        ci = np.clip((as_numpy(pos) / cellsize).astype('i8'), 0,
+                     ncell - 1)
+        flat = (ci[:, 0] * ncell[1] + ci[:, 1]) * ncell[2] + ci[:, 2]
+        K = int(np.bincount(flat).max())
+
+        roots = _fof_labels(jnp.asarray(pos), BoxSize, self._ll, K)
+
+        # compact + size-ordered halo labels (reference _assign_labels)
+        roots_np = as_numpy(roots)
+        uniq, inv, counts = np.unique(roots_np, return_inverse=True,
+                                      return_counts=True)
+        nmin = self.attrs['nmin']
+        # order by descending count among groups >= nmin
+        eligible = counts >= nmin
+        order = np.argsort(-counts[eligible], kind='stable')
+        label_map = np.zeros(len(uniq), dtype='i8')
+        label_map[np.flatnonzero(eligible)[order]] = \
+            np.arange(1, eligible.sum() + 1)
+        labels = label_map[inv]
+        self._halo_count = int(eligible.sum())
+        return jnp.asarray(labels)
+
+    def find_features(self, peakcolumn=None):
+        """The halo catalog as a BinnedStatistic-free ArrayCatalog with
+        Length / CMPosition / CMVelocity (+ peak position when
+        ``peakcolumn`` given); reference fof_catalog (fof.py:427-533)."""
+        from ..source.catalog.array import ArrayCatalog
+        data = fof_catalog(self._source, self.labels,
+                           self._halo_count + 1,
+                           self.attrs['BoxSize'],
+                           periodic=self.attrs['periodic'],
+                           peakcolumn=peakcolumn)
+        cat = ArrayCatalog(data, comm=self.comm, **self.attrs)
+        return cat
+
+    def to_halos(self, particle_mass, cosmo, redshift, mdef='vir'):
+        """A HaloCatalog with Position/Velocity/Mass (reference
+        fof.py:130)."""
+        from ..source.catalog.halos import HaloCatalog
+        features = self.find_features()
+        # drop label 0 (unbound particles)
+        sel = np.arange(1, len(features))
+        data = {
+            'Position': features['CMPosition'][1:],
+            'Velocity': features['CMVelocity'][1:],
+            'Length': features['Length'][1:],
+        }
+        from ..source.catalog.array import ArrayCatalog
+        attrs = dict(self.attrs)
+        attrs.update(particle_mass=particle_mass, redshift=redshift,
+                     mdef=mdef)
+        cat = ArrayCatalog(data, comm=self.comm, **attrs)
+        return HaloCatalog(cat, cosmo=cosmo, redshift=redshift,
+                           mdef=mdef, mass='Mass', position='Position',
+                           velocity='Velocity',
+                           particle_mass=particle_mass)
+
+
+def fof_catalog(source, labels, nhalo, BoxSize, periodic=True,
+                peakcolumn=None):
+    """Per-halo reductions: Length, periodic center-of-mass position,
+    mean velocity (reference fof_catalog/centerofmass,
+    fof.py:427-727)."""
+    labels = jnp.asarray(labels)
+    pos = jnp.asarray(source['Position'])
+    box = jnp.asarray(BoxSize, pos.dtype)
+
+    length = jnp.bincount(labels, length=nhalo)
+
+    # periodic center of mass: average offsets relative to a reference
+    # particle per halo (the reference uses the same relative-unwrap
+    # trick, fof.py:589-643)
+    first_idx = jnp.zeros(nhalo, dtype=jnp.int32).at[labels[::-1]].set(
+        jnp.arange(len(labels) - 1, -1, -1, dtype=jnp.int32))
+    ref = pos[first_idx][labels]
+    d = pos - ref
+    if periodic:
+        d = d - jnp.round(d / box) * box
+    dsum = jnp.zeros((nhalo, 3), pos.dtype).at[labels].add(d)
+    lsafe = jnp.maximum(length, 1).astype(pos.dtype)[:, None]
+    cm = pos[first_idx] + dsum / lsafe
+    if periodic:
+        cm = jnp.mod(cm, box)
+
+    data = {
+        'Length': length,
+        'CMPosition': cm,
+    }
+
+    if 'Velocity' in source:
+        vel = jnp.asarray(source['Velocity'])
+        vsum = jnp.zeros((nhalo, 3), vel.dtype).at[labels].add(vel)
+        data['CMVelocity'] = vsum / lsafe
+    else:
+        data['CMVelocity'] = jnp.zeros((nhalo, 3), pos.dtype)
+
+    if peakcolumn is not None and peakcolumn in source:
+        density = jnp.asarray(source[peakcolumn])
+        # argmax per halo via segment max on (density, index) pairs
+        neg = jnp.full(nhalo, -jnp.inf, dtype=density.dtype)
+        dmax = neg.at[labels].max(density)
+        ispeak = density >= dmax[labels]
+        # first peak particle per halo
+        peak_idx = jnp.full(nhalo, 0, jnp.int32).at[
+            jnp.where(ispeak, labels, nhalo - 1)].max(
+            jnp.arange(len(labels), dtype=jnp.int32))
+        data['PeakPosition'] = pos[peak_idx]
+        if 'Velocity' in source:
+            data['PeakVelocity'] = jnp.asarray(
+                source['Velocity'])[peak_idx]
+
+    return {k: as_numpy(v) for k, v in data.items()}
+
+
+class HaloLabelCatalog(CatalogSourceBase):
+    pass

@@ -28,3 +28,5 @@ from .source.catalog.file import (CSVCatalog, BinaryCatalog,  # noqa: F401,E402
 from .source.mesh.bigfile import BigFileMesh  # noqa: F401,E402
 from .algorithms.fftrecon import FFTRecon  # noqa: F401,E402
 from . import io  # noqa: F401,E402
+from .algorithms.fof import FOF  # noqa: F401,E402
+from .source.catalog.halos import HaloCatalog  # noqa: F401,E402

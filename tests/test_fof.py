@@ -1,0 +1,139 @@
+"""FOF tests: brute-force oracle on small N, known cluster layouts,
+halo property reductions (reference analog:
+algorithms/tests/test_fof.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from nbodykit_tpu.lab import ArrayCatalog, UniformCatalog
+from nbodykit_tpu.algorithms.fof import FOF
+
+
+def brute_force_fof(pos, ll, box):
+    """O(N^2) union-find oracle with periodic distances."""
+    N = len(pos)
+    parent = np.arange(N)
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for i in range(N):
+        for j in range(i + 1, N):
+            d = pos[i] - pos[j]
+            d -= np.round(d / box) * box
+            if (d ** 2).sum() <= ll * ll:
+                ri, rj = find(i), find(j)
+                if ri != rj:
+                    parent[ri] = rj
+    return np.array([find(i) for i in range(N)])
+
+
+def same_partition(a, b):
+    """Two labelings describe the same partition?"""
+    m = {}
+    for x, y in zip(a, b):
+        if x in m and m[x] != y:
+            return False
+        m[x] = y
+    m = {}
+    for x, y in zip(b, a):
+        if x in m and m[x] != y:
+            return False
+        m[x] = y
+    return True
+
+
+def test_fof_matches_brute_force():
+    rng = np.random.RandomState(0)
+    pos = rng.uniform(0, 50.0, size=(300, 3))
+    cat = ArrayCatalog({'Position': pos}, BoxSize=50.0)
+    ll_abs = 3.0
+    fof = FOF(cat, linking_length=ll_abs, nmin=1, absolute=True)
+    want = brute_force_fof(pos, ll_abs, 50.0)
+    got = np.asarray(fof.labels)
+    assert same_partition(got, want)
+
+
+def test_fof_two_well_separated_clusters():
+    rng = np.random.RandomState(1)
+    c1 = rng.normal(20, 0.5, size=(40, 3))
+    c2 = rng.normal(70, 0.5, size=(25, 3))
+    lone = np.array([[45.0, 45.0, 45.0]])
+    pos = np.concatenate([c1, c2, lone])
+    cat = ArrayCatalog({'Position': pos}, BoxSize=100.0)
+    fof = FOF(cat, linking_length=3.0, nmin=5, absolute=True)
+    labels = np.asarray(fof.labels)
+    # two halos, ordered by size: cluster1 -> 1, cluster2 -> 2, lone -> 0
+    assert set(labels[:40]) == {1}
+    assert set(labels[40:65]) == {2}
+    assert labels[65] == 0
+
+
+def test_fof_periodic_wrap():
+    # a cluster straddling the periodic boundary must be one group
+    pos = np.array([[0.5, 10.0, 10.0],
+                    [99.5, 10.0, 10.0],
+                    [1.5, 10.0, 10.0],
+                    [98.5, 10.0, 10.0]])
+    cat = ArrayCatalog({'Position': pos}, BoxSize=100.0)
+    fof = FOF(cat, linking_length=1.6, nmin=2, absolute=True)
+    labels = np.asarray(fof.labels)
+    assert len(set(labels)) == 1 and labels[0] == 1
+
+
+def test_fof_features_and_com():
+    rng = np.random.RandomState(2)
+    center = np.array([10.0, 20.0, 30.0])
+    cluster = center + rng.normal(0, 0.3, size=(50, 3))
+    vel = np.ones((50, 3)) * 7.0
+    cat = ArrayCatalog({'Position': cluster, 'Velocity': vel},
+                       BoxSize=100.0)
+    fof = FOF(cat, linking_length=2.0, nmin=5, absolute=True)
+    halos = fof.find_features()
+    assert halos['Length'][1] == 50
+    np.testing.assert_allclose(np.asarray(halos['CMPosition'][1]),
+                               cluster.mean(axis=0), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(halos['CMVelocity'][1]), 7.0,
+                               rtol=1e-6)
+
+
+def test_fof_com_periodic():
+    # center of mass of a boundary-straddling group is near the seam,
+    # not the box center
+    pos = np.array([[99.0, 5.0, 5.0], [1.0, 5.0, 5.0]])
+    cat = ArrayCatalog({'Position': pos}, BoxSize=100.0)
+    fof = FOF(cat, linking_length=3.0, nmin=2, absolute=True)
+    halos = fof.find_features()
+    cm = np.asarray(halos['CMPosition'][1])
+    assert cm[0] > 99.0 or cm[0] < 1.0
+
+
+def test_fof_to_halos():
+    from nbodykit_tpu.cosmology import Planck15
+    rng = np.random.RandomState(3)
+    clusters = []
+    for c in [15.0, 45.0, 80.0]:
+        clusters.append(rng.normal(c, 0.4, size=(30, 3)))
+    pos = np.concatenate(clusters)
+    vel = rng.normal(0, 100.0, size=pos.shape)
+    cat = ArrayCatalog({'Position': pos, 'Velocity': vel},
+                       BoxSize=100.0)
+    fof = FOF(cat, linking_length=2.0, nmin=10, absolute=True)
+    halos = fof.to_halos(particle_mass=1e12, cosmo=Planck15, redshift=0.)
+    assert halos.csize == 3
+    np.testing.assert_allclose(np.asarray(halos['Mass']), 30 * 1e12)
+    assert np.all(np.asarray(halos['Radius']) > 0)
+    assert np.all(np.asarray(halos['Concentration']) > 1)
+
+
+def test_fof_mean_separation_units():
+    cat = UniformCatalog(nbar=1e-3, BoxSize=64.0, seed=9)
+    fof = FOF(cat, linking_length=0.2, nmin=5)
+    labels = np.asarray(fof.labels)
+    assert labels.min() >= 0
+    # most particles are isolated at this density
+    assert (labels == 0).mean() > 0.5
