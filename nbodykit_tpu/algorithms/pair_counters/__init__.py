@@ -1,0 +1,4 @@
+from .simbox import SimulationBoxPairCount
+from .mocksurvey import SurveyDataPairCount
+
+__all__ = ['SimulationBoxPairCount', 'SurveyDataPairCount']

@@ -1,0 +1,83 @@
+"""SurveyDataPairCount: pair counts of sky catalogs.
+
+Reference: ``nbodykit/algorithms/pair_counters/mocksurvey.py`` (wrapping
+Corrfunc mocks kernels DDsmu_mocks/DDtheta_mocks): positions come as
+(ra, dec[, redshift]) converted to Cartesian with a cosmology; counting
+is non-periodic in a data-derived bounding box.
+"""
+
+import numpy as np
+
+from .base import PairCountBase, package_result
+from .core import paircount
+from ...utils import as_numpy
+from ... import transform
+
+
+class SurveyDataPairCount(PairCountBase):
+    """Count weighted pairs of survey (sky) data.
+
+    Parameters (reference mocksurvey.py): mode in {'1d','2d','angular',
+    'projected'}, catalogs with ra/dec(/redshift) columns, edges,
+    cosmo (for comoving distances), Nmu, pimax, weight.
+    """
+
+    def __init__(self, mode, first, edges, cosmo=None, second=None,
+                 Nmu=None, pimax=None, ra='RA', dec='DEC',
+                 redshift='Redshift', weight='Weight',
+                 show_progress=False):
+        if mode not in ('1d', '2d', 'projected', 'angular'):
+            raise ValueError("invalid mode %r" % mode)
+        self.comm = first.comm
+        self.attrs = dict(mode=mode, edges=np.asarray(edges), Nmu=Nmu,
+                          pimax=pimax, weight=weight)
+
+        def get_pos(cat):
+            if mode == 'angular':
+                pos = transform.SkyToUnitSphere(cat[ra], cat[dec])
+                return as_numpy(pos)
+            if cosmo is None:
+                raise ValueError("need a cosmology to convert redshifts "
+                                 "to distances")
+            pos = transform.SkyToCartesian(cat[ra], cat[dec],
+                                           cat[redshift], cosmo)
+            return as_numpy(pos)
+
+        pos1 = get_pos(first)
+        w1 = as_numpy(first[weight]) if weight in first else None
+        if second is None or second is first:
+            pos2, w2 = pos1, w1
+            is_auto = True
+        else:
+            pos2 = get_pos(second)
+            w2 = as_numpy(second[weight]) if weight in second else None
+            is_auto = False
+
+        if mode == 'angular':
+            box = np.ones(3)  # unused by the angular path
+            counts = paircount(pos1, w1, pos2, w2, box, edges,
+                               mode=mode, periodic=False,
+                               is_auto=is_auto)
+        else:
+            # non-periodic bounding box; mu against the pair midpoint
+            # direction from the observer (Corrfunc-mocks convention)
+            lo = np.minimum(pos1.min(axis=0), pos2.min(axis=0))
+            hi = np.maximum(pos1.max(axis=0), pos2.max(axis=0))
+            box = (hi - lo) * 1.001 + 1e-3
+            counts = paircount(pos1, w1, pos2, w2, box, edges,
+                               mode=mode, Nmu=Nmu, pimax=pimax,
+                               periodic=False, is_auto=is_auto,
+                               grid_origin=lo, pair_los='midpoint')
+
+        W1 = float(np.sum(w1)) if w1 is not None else float(len(pos1))
+        W2 = float(np.sum(w2)) if w2 is not None else float(len(pos2))
+        if is_auto:
+            sumw2 = float(np.sum((w1 if w1 is not None
+                                  else np.ones(len(pos1))) ** 2))
+            total = W1 * W1 - sumw2
+        else:
+            total = W1 * W2
+        self.attrs.update(total_wnpairs=total, W1=W1, W2=W2,
+                          N1=len(pos1), N2=len(pos2), is_auto=is_auto)
+
+        self.pairs = package_result(counts, **self.attrs)

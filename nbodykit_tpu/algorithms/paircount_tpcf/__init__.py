@@ -1,0 +1,3 @@
+from .tpcf import SimulationBox2PCF, SurveyData2PCF
+
+__all__ = ['SimulationBox2PCF', 'SurveyData2PCF']

@@ -30,3 +30,7 @@ from .algorithms.fftrecon import FFTRecon  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from .algorithms.fof import FOF  # noqa: F401,E402
 from .source.catalog.halos import HaloCatalog  # noqa: F401,E402
+from .algorithms.pair_counters import (SimulationBoxPairCount,  # noqa: F401,E402
+                                       SurveyDataPairCount)
+from .algorithms.paircount_tpcf import (SimulationBox2PCF,  # noqa: F401,E402
+                                        SurveyData2PCF)
