@@ -1,0 +1,121 @@
+"""FiberCollisions: spectroscopic fiber assignment simulation.
+
+Reference: ``nbodykit/algorithms/fibercollisions.py:8`` — angular FOF
+groups at the collision radius, then fiber assignment minimizing the
+number of collided objects (Guo et al. 2012 procedure): pairs collide
+one random member; larger multiplets iteratively remove the member with
+the most collisions (ties broken by fewest neighbor collisions, then
+randomly).
+
+The angular FOF reuses :class:`..algorithms.fof.FOF` on unit-sphere
+Cartesian coordinates with an absolute chord linking length; the
+group-by-group assignment is a host-side loop over (small) groups.
+"""
+
+import logging
+
+import numpy as np
+
+from ..source.catalog.array import ArrayCatalog
+from ..transform import SkyToUnitSphere
+from ..utils import as_numpy
+from .fof import FOF
+
+
+class FiberCollisions(object):
+    """Assign fibers to (ra, dec) objects.
+
+    Results in :attr:`labels` — an ArrayCatalog with Label (angular
+    group), Collided (0/1), NeighborID (global index of the nearest
+    uncollided neighbor for collided objects, else -1).
+    """
+
+    logger = logging.getLogger('FiberCollisions')
+
+    def __init__(self, ra, dec, collision_radius=62. / 60. / 60.,
+                 seed=None, degrees=True, comm=None):
+        ra = as_numpy(ra)
+        dec = as_numpy(dec)
+        self._collision_radius_rad = np.radians(
+            collision_radius if degrees else np.degrees(
+                collision_radius))
+        # chord length corresponding to the angular radius
+        self._chord = 2 * np.sin(0.5 * self._collision_radius_rad)
+        if seed is None:
+            seed = np.random.randint(0, 2 ** 31 - 1)
+        self.attrs = dict(collision_radius=collision_radius, seed=seed)
+
+        pos = np.asarray(SkyToUnitSphere(ra, dec))
+        # place the unit sphere inside a non-wrapping box for FOF
+        shifted = pos + 2.0
+        cat = ArrayCatalog({'Position': shifted}, BoxSize=4.0)
+        self.comm = cat.comm
+
+        fof = FOF(cat, linking_length=self._chord, nmin=2,
+                  absolute=True)
+        labels = np.asarray(fof.labels)
+
+        collided, neighbors = self._assign_fibers(pos, labels, seed)
+
+        N1 = int((collided == 0).sum())
+        N2 = int(collided.sum())
+        self.logger.info("population 1 (clean) = %d, population 2 "
+                         "(collided) = %d, fraction = %.4f"
+                         % (N1, N2, N2 / max(N1 + N2, 1)))
+
+        self.labels = ArrayCatalog(
+            {'Label': labels, 'Collided': collided.astype('i4'),
+             'NeighborID': neighbors.astype('i4')})
+        self.labels.attrs.update(self.attrs)
+
+    def _assign_fibers(self, pos, labels, seed):
+        rng = np.random.RandomState(seed)
+        N = len(pos)
+        collided = np.zeros(N, dtype='i4')
+        neighbors = np.full(N, -1, dtype='i4')
+
+        for lab in np.unique(labels):
+            if lab == 0:
+                continue
+            members = np.flatnonzero(labels == lab)
+            if len(members) == 2:
+                which = rng.choice(2)
+                collided[members[which]] = 1
+                neighbors[members[which]] = members[which ^ 1]
+                continue
+            coll_ids, neigh = self._assign_multiplet(
+                pos[members], rng)
+            collided[members[coll_ids]] = 1
+            for ci, ni in zip(coll_ids, neigh):
+                neighbors[members[ci]] = members[ni]
+        return collided, neighbors
+
+    def _assign_multiplet(self, P, rng):
+        """Greedy removal for groups of size > 2 (reference
+        _assign_multiplets, fibercollisions.py:232)."""
+        n = len(P)
+        group_ids = list(range(n))
+        collided_ids = []
+        d = np.sqrt(((P[:, None, :] - P[None, :, :]) ** 2).sum(-1))
+        np.fill_diagonal(d, np.inf)
+        while len(group_ids) > 1:
+            sub = d[np.ix_(group_ids, group_ids)]
+            collisions = sub <= self._chord
+            ncoll = collisions.sum(axis=0)
+            if ncoll.max() == 0:
+                break
+            nother = np.array([ncoll[collisions[:, i]].sum()
+                               for i in range(len(group_ids))])
+            idx = np.flatnonzero(ncoll == ncoll.max())
+            ii = rng.choice(np.flatnonzero(
+                nother[idx] == nother[idx].min()))
+            collided_index = idx[ii]
+            cid = group_ids.pop(collided_index)
+            if ncoll[collided_index] > 0:
+                collided_ids.append(cid)
+
+        uncollided = [i for i in range(n) if i not in collided_ids]
+        neigh = []
+        for i in sorted(collided_ids):
+            neigh.append(uncollided[int(np.argmin(d[i][uncollided]))])
+        return sorted(collided_ids), neigh

@@ -44,14 +44,14 @@ def _fof_labels(pos, BoxSize, ll, K):
     """
     N = pos.shape[0]
     box = jnp.asarray(BoxSize, pos.dtype)
-    ncell = np.maximum(np.asarray(BoxSize) / ll, 3.0).astype('i8')
-    ncell = jnp.asarray(ncell, jnp.int32)
+    ncell_np = np.clip(np.floor(np.asarray(BoxSize) / ll),
+                       1.0, 256.0).astype('i8')
+    ncell = jnp.asarray(ncell_np, jnp.int32)
     cellsize = box / ncell
 
     ci = jnp.clip((pos / cellsize).astype(jnp.int32), 0, ncell - 1)
     flat = (ci[:, 0] * ncell[1] + ci[:, 1]) * ncell[2] + ci[:, 2]
-    ncells_tot = int(np.prod(np.maximum(np.asarray(BoxSize) / ll, 3.0)
-                             .astype('i8')))
+    ncells_tot = int(np.prod(ncell_np))
 
     order = jnp.argsort(flat)
     flat_s = flat[order]
@@ -64,10 +64,10 @@ def _fof_labels(pos, BoxSize, ll, K):
                                                 dtype=flat_s.dtype),
                              side='right') - start
 
-    # neighbor cells (27 offsets, periodic)
-    offs = jnp.asarray([(i, j, k) for i in (-1, 0, 1)
-                        for j in (-1, 0, 1) for k in (-1, 0, 1)],
-                       dtype=jnp.int32)
+    # neighbor cells (periodic; offsets deduplicated for tiny grids)
+    from .pair_counters.core import neighbor_offsets
+    offs_list = neighbor_offsets(ncell_np)
+    offs = jnp.asarray(offs_list, dtype=jnp.int32)
     ci_s = ci[order]
 
     ll2 = jnp.asarray(ll * ll, pos.dtype)
@@ -75,7 +75,7 @@ def _fof_labels(pos, BoxSize, ll, K):
     def neighbor_min(labels):
         """For each particle: min label among particles within ll."""
         best = labels
-        for oi in range(27):
+        for oi in range(len(offs_list)):
             nc = jnp.mod(ci_s + offs[oi], ncell)
             nflat = (nc[:, 0] * ncell[1] + nc[:, 1]) * ncell[2] + nc[:, 2]
             s = start[nflat]
@@ -164,7 +164,8 @@ class FOF(object):
         BoxSize = self.attrs['BoxSize']
 
         # static per-cell capacity from the data (eager host computation)
-        ncell = np.maximum(BoxSize / self._ll, 3.0).astype('i8')
+        ncell = np.clip(np.floor(BoxSize / self._ll), 1.0,
+                        256.0).astype('i8')
         cellsize = BoxSize / ncell
         ci = np.clip((as_numpy(pos) / cellsize).astype('i8'), 0,
                      ncell - 1)

@@ -1,0 +1,91 @@
+"""KDDensity: a fast per-particle density proxy.
+
+Reference: ``nbodykit/algorithms/kdtree.py:9`` — crude density from
+nearest-neighbor distances (scipy cKDTree + domain ghosts there).
+TPU redesign: neighbor *counts* within a kernel radius via the same
+grid-hash sweep as FOF/pair counting, fully vectorized; the density
+proxy is count / kernel volume.
+"""
+
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils import as_numpy
+
+
+class KDDensity(object):
+    """Estimate a local density proxy for every object.
+
+    Parameters
+    ----------
+    source : CatalogSource with Position and attrs['BoxSize']
+    margin : float — kernel radius in units of the mean inter-particle
+        separation (reference uses a margin-scaled proximity too)
+
+    Attributes
+    ----------
+    density : (N,) density proxy (neighbors within the kernel / kernel
+        volume), same normalization role as the reference's proxy.
+    """
+
+    logger = logging.getLogger('KDDensity')
+
+    def __init__(self, source, margin=1.0):
+        if 'Position' not in source:
+            raise ValueError("source needs a Position column")
+        self.comm = source.comm
+        BoxSize = np.ones(3) * np.asarray(source.attrs['BoxSize'],
+                                          dtype='f8')
+        self.attrs = dict(margin=margin, BoxSize=BoxSize)
+
+        pos = as_numpy(source['Position'])
+        N = len(pos)
+        mean_sep = (np.prod(BoxSize) / N) ** (1.0 / 3)
+        r = margin * mean_sep
+        self.attrs['kernel_radius'] = r
+
+        from .pair_counters.core import _hash_secondary, neighbor_offsets
+        order, flat_s, ncell, cellsize, K = _hash_secondary(
+            pos, BoxSize, r)
+        offs_list = neighbor_offsets(ncell)
+        pos_s = jnp.asarray(pos[order])
+        ncells_tot = int(np.prod(ncell))
+        start = jnp.asarray(np.searchsorted(flat_s,
+                                            np.arange(ncells_tot)))
+        count = jnp.asarray(np.searchsorted(
+            flat_s, np.arange(ncells_tot), side='right')) - start
+
+        ncell_j = jnp.asarray(ncell, jnp.int32)
+        cellsize_j = jnp.asarray(cellsize)
+        boxj = jnp.asarray(BoxSize)
+        offs = jnp.asarray(offs_list, dtype=jnp.int32)
+        r2 = r * r
+
+        @jax.jit
+        def neighbor_counts(p):
+            ci = jnp.clip((p / cellsize_j).astype(jnp.int32), 0,
+                          ncell_j - 1)
+            total = jnp.zeros(p.shape[0])
+            for oi in range(len(offs_list)):
+                nc = jnp.mod(ci + offs[oi], ncell_j)
+                nflat = (nc[:, 0] * ncell_j[1] + nc[:, 1]) \
+                    * ncell_j[2] + nc[:, 2]
+                s = start[nflat]
+                c = count[nflat]
+                for slot in range(K):
+                    j = s + slot
+                    valid = slot < c
+                    j = jnp.where(valid, j, 0)
+                    d = p - pos_s[j]
+                    d = d - jnp.round(d / boxj) * boxj
+                    rr2 = jnp.sum(d * d, axis=-1)
+                    total = total + jnp.where(valid & (rr2 <= r2),
+                                              1.0, 0.0)
+            return total
+
+        counts_per = neighbor_counts(jnp.asarray(pos))
+        vol = 4.0 / 3 * np.pi * r ** 3
+        self.density = counts_per / vol

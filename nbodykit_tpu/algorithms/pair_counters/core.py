@@ -19,6 +19,26 @@ import jax
 import jax.numpy as jnp
 
 
+def neighbor_offsets(ncell, periodic=True):
+    """The neighbor-cell offset triples, deduplicated for tiny grids:
+    with n cells along an axis and periodic wrapping, offsets -1 and +1
+    alias to the same cell when n < 3 (and everything aliases to 0 when
+    n == 1) — visiting an aliased offset twice double-counts pairs."""
+    per_axis = []
+    for n in np.atleast_1d(ncell):
+        if periodic:
+            if n >= 3:
+                per_axis.append((-1, 0, 1))
+            elif n == 2:
+                per_axis.append((0, 1))
+            else:
+                per_axis.append((0,))
+        else:
+            per_axis.append((-1, 0, 1) if n >= 2 else (0,))
+    return [(i, j, k) for i in per_axis[0] for j in per_axis[1]
+            for k in per_axis[2]]
+
+
 def _hash_secondary(pos2, box, rmax):
     """Sort the secondary set by rmax-sized cells; returns the sorted
     arrays + cell lookup tables + static capacity K."""
@@ -114,9 +134,8 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
     cellsize_j = jnp.asarray(cellsize)
     boxj = jnp.asarray(work_box)
     r2edges = jnp.asarray(redges ** 2)
-    offs = jnp.asarray([(i, j, k) for i in (-1, 0, 1)
-                        for j in (-1, 0, 1) for k in (-1, 0, 1)],
-                       dtype=jnp.int32)
+    offs_list = neighbor_offsets(ncell, periodic=periodic)
+    offs = jnp.asarray(offs_list, dtype=jnp.int32)
     use_wrap = bool(periodic)
     losj = int(los)
     origin_j = jnp.asarray(np.broadcast_to(
@@ -129,7 +148,7 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
                        ncell_j - 1)
         npairs = jnp.zeros(nbins_flat, jnp.float64)
         wpairs = jnp.zeros(nbins_flat, jnp.float64)
-        for oi in range(27):
+        for oi in range(len(offs_list)):
             nc = ci1 + offs[oi]
             if use_wrap:
                 nc = jnp.mod(nc, ncell_j)

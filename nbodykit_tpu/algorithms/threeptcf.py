@@ -1,0 +1,198 @@
+"""Isotropic 3-point correlation function multipoles.
+
+Reference: ``nbodykit/algorithms/threeptcf.py:8`` — the Slepian &
+Eisenstein (2015) O(N^2) algorithm: around every primary, accumulate
+spherical-harmonic moments a_lm(r-bin) of its neighbors; then
+
+    zeta_l(b1, b2) = sum_i w_i (4 pi / (2l+1)) sum_m
+                         a_lm(i, b1) a_lm(i, b2)
+                   = sum_i w_i sum_{j in b1, k in b2} w_j w_k
+                         P_l(rhat_ij . rhat_ik)
+
+(real-Ylm addition theorem). The reference builds its Ylm table with
+sympy (YlmCache, :393); here the jnp real harmonics of
+:func:`..convpower.fkp.get_real_Ylm` are reused, so the whole neighbor
+sweep + moment accumulation + (b1, b2) outer product runs as one jitted
+program (the outer product lands on the MXU).
+"""
+
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .convpower.fkp import get_real_Ylm
+from ..binned_statistic import BinnedStatistic
+from ..utils import as_numpy
+from .. import transform
+
+
+class Base3PCF(object):
+    """Shared SE accumulation (reference threeptcf.py:35-190)."""
+
+    def _run(self, pos, w, edges, poles, BoxSize=None, periodic=True):
+        edges = np.asarray(edges, dtype='f8')
+        nbins = len(edges) - 1
+        rmax = edges[-1]
+        N = len(pos)
+
+        if BoxSize is None:
+            lo = pos.min(axis=0)
+            hi = pos.max(axis=0)
+            box = (hi - lo) * 1.001 + 1e-3
+            origin = lo
+            periodic = False
+        else:
+            box = np.ones(3) * np.asarray(BoxSize, dtype='f8')
+            origin = np.zeros(3)
+
+        from .pair_counters.core import _hash_secondary
+        order, flat_s, ncell, cellsize, K = _hash_secondary(
+            pos - origin, box, rmax)
+        pos_s = jnp.asarray((pos - origin)[order])
+        w_s = jnp.asarray(w[order])
+        ncells_tot = int(np.prod(ncell))
+        start = jnp.asarray(np.searchsorted(
+            flat_s, np.arange(ncells_tot)))
+        count = jnp.asarray(np.searchsorted(
+            flat_s, np.arange(ncells_tot), side='right')) - start
+
+        ncell_j = jnp.asarray(ncell, jnp.int32)
+        cellsize_j = jnp.asarray(cellsize)
+        boxj = jnp.asarray(box)
+        r2edges = jnp.asarray(edges ** 2)
+        from .pair_counters.core import neighbor_offsets
+        offs_list = neighbor_offsets(ncell, periodic=periodic)
+        offs = jnp.asarray(offs_list, dtype=jnp.int32)
+        use_wrap = bool(periodic)
+
+        ells = sorted(poles)
+        ylms = [(ell, [get_real_Ylm(ell, m)
+                       for m in range(-ell, ell + 1)]) for ell in ells]
+
+        def chunk_zeta(args):
+            p1c, w1c, live = args
+            C = p1c.shape[0]
+            ci = jnp.clip((p1c / cellsize_j).astype(jnp.int32), 0,
+                          ncell_j - 1)
+            # a_lm moments per (primary, lm, bin)
+            nlm = sum(2 * ell + 1 for ell in ells)
+            alm = jnp.zeros((C, nlm, nbins))
+            for oi in range(len(offs_list)):
+                nc = ci + offs[oi]
+                if use_wrap:
+                    nc = jnp.mod(nc, ncell_j)
+                else:
+                    nc = jnp.clip(nc, 0, ncell_j - 1)
+                nflat = (nc[:, 0] * ncell_j[1] + nc[:, 1]) \
+                    * ncell_j[2] + nc[:, 2]
+                s = start[nflat]
+                c = count[nflat]
+                for slot in range(K):
+                    j = s + slot
+                    valid = (slot < c) & live
+                    j = jnp.where(valid, j, 0)
+                    d = pos_s[j] - p1c
+                    if use_wrap:
+                        d = d - jnp.round(d / boxj) * boxj
+                    r2 = jnp.sum(d * d, axis=-1)
+                    ok = valid & (r2 > 1e-20)
+                    rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
+                    u = d / rr[:, None]
+                    dig = jnp.digitize(r2, r2edges) - 1
+                    inb = ok & (dig >= 0) & (dig < nbins)
+                    digc = jnp.clip(dig, 0, nbins - 1)
+                    wj = jnp.where(inb, w_s[j], 0.0)
+                    ilm = 0
+                    onehot = jax.nn.one_hot(digc, nbins) \
+                        * wj[:, None]  # (C, nbins)
+                    for ell, Ys in ylms:
+                        for Y in Ys:
+                            yv = Y(u[:, 0], u[:, 1], u[:, 2])
+                            alm = alm.at[:, ilm, :].add(
+                                yv[:, None] * onehot)
+                            ilm += 1
+            # zeta_l(b1,b2) = sum_i w_i (4pi/(2l+1)) sum_m alm alm^T
+            outs = []
+            ilm = 0
+            for ell, Ys in ylms:
+                nm = 2 * ell + 1
+                a = alm[:, ilm:ilm + nm, :]  # (C, nm, nbins)
+                z = jnp.einsum('i,imb,imc->bc', w1c, a, a)
+                outs.append(z * (4 * np.pi / nm))
+                ilm += nm
+            return jnp.stack(outs)
+
+        chunk = 2048
+        nchunks = max(1, (N + chunk - 1) // chunk)
+        npad = nchunks * chunk
+        p1 = np.concatenate([pos - origin, np.zeros((npad - N, 3))])
+        w1 = np.concatenate([w, np.zeros(npad - N)])
+        live = np.concatenate([np.ones(N, bool),
+                               np.zeros(npad - N, bool)])
+        res = jax.lax.map(chunk_zeta,
+                          (jnp.asarray(p1).reshape(nchunks, chunk, 3),
+                           jnp.asarray(w1).reshape(nchunks, chunk),
+                           jnp.asarray(live).reshape(nchunks, chunk)))
+        zetas = np.array(res.sum(axis=0))  # (nell, nbins, nbins)
+
+        data = {}
+        centers = 0.5 * (edges[1:] + edges[:-1])
+        data['r1'] = np.broadcast_to(centers[:, None],
+                                     (nbins, nbins)).copy()
+        data['r2'] = np.broadcast_to(centers[None, :],
+                                     (nbins, nbins)).copy()
+        for i, ell in enumerate(ells):
+            data['corr_%d' % ell] = zetas[i]
+        poles_ds = BinnedStatistic(['r1', 'r2'], [edges, edges], data)
+        poles_ds.attrs.update(self.attrs)
+        return poles_ds
+
+    def save(self, output):
+        import json
+        from ..utils import JSONEncoder
+        with open(output, 'w') as ff:
+            json.dump(dict(poles=self.poles.__getstate__(),
+                           attrs=self.attrs), ff, cls=JSONEncoder)
+
+
+class SimulationBox3PCF(Base3PCF):
+    """zeta_l(r1, r2) in a periodic box (reference threeptcf.py:193)."""
+
+    logger = logging.getLogger('SimulationBox3PCF')
+
+    def __init__(self, source, poles, edges, BoxSize=None,
+                 periodic=True, weight='Weight', position='Position'):
+        self.comm = source.comm
+        if BoxSize is None:
+            BoxSize = source.attrs['BoxSize']
+        self.attrs = dict(poles=list(poles),
+                          edges=np.asarray(edges, 'f8'),
+                          BoxSize=np.ones(3) * np.asarray(BoxSize),
+                          periodic=periodic)
+        pos = as_numpy(source[position])
+        w = as_numpy(source[weight]) if weight in source else \
+            np.ones(len(pos))
+        self.poles = self._run(pos, w, edges, poles,
+                               BoxSize=self.attrs['BoxSize'],
+                               periodic=periodic)
+
+
+class SurveyData3PCF(Base3PCF):
+    """zeta_l(r1, r2) of survey (sky) data (reference
+    threeptcf.py:290)."""
+
+    logger = logging.getLogger('SurveyData3PCF')
+
+    def __init__(self, source, poles, edges, cosmo, ra='RA', dec='DEC',
+                 redshift='Redshift', weight='Weight'):
+        self.comm = source.comm
+        self.attrs = dict(poles=list(poles),
+                          edges=np.asarray(edges, 'f8'))
+        pos = as_numpy(transform.SkyToCartesian(
+            source[ra], source[dec], source[redshift], cosmo))
+        w = as_numpy(source[weight]) if weight in source else \
+            np.ones(len(pos))
+        self.poles = self._run(pos, w, edges, poles, BoxSize=None,
+                               periodic=False)

@@ -1,0 +1,84 @@
+"""RedshiftHistogram: weighted n(z) of a catalog.
+
+Reference: ``nbodykit/algorithms/zhist.py:9`` — histogram of a redshift
+column with automatic Scott's-rule binning, normalized to the comoving
+number density n(z) using a fiducial cosmology.
+"""
+
+import logging
+
+import numpy as np
+
+from ..binned_statistic import BinnedStatistic
+from ..utils import as_numpy
+
+
+def scotts_bin_width(data):
+    """Scott's rule bin width: 3.5 sigma / N^(1/3)."""
+    data = np.asarray(data)
+    sigma = data.std()
+    n = len(data)
+    if sigma == 0 or n == 0:
+        return 0.1
+    return 3.5 * sigma / n ** (1.0 / 3)
+
+
+class RedshiftHistogram(object):
+    """n(z) from a catalog.
+
+    Parameters (reference zhist.py): source, fsky (sky fraction the
+    catalog covers), cosmo (for comoving volumes), bins (int, edges, or
+    None for Scott's rule), redshift/weight column names.
+
+    Attributes
+    ----------
+    bin_edges, bin_centers : the z binning
+    dV : comoving volume per bin, (Mpc/h)^3
+    nbar : weighted number density per bin
+    """
+
+    logger = logging.getLogger('RedshiftHistogram')
+
+    def __init__(self, source, fsky, cosmo, bins=None, redshift='Redshift',
+                 weight=None):
+        self.source = source
+        self.comm = source.comm
+        self.attrs = dict(fsky=fsky, redshift=redshift, weight=weight)
+
+        z = as_numpy(source[redshift])
+        w = as_numpy(source[weight]) if weight is not None else \
+            np.ones(len(z))
+
+        if bins is None:
+            dz = scotts_bin_width(z)
+            bins = np.arange(z.min(), z.max() + dz, dz)
+        elif np.isscalar(bins):
+            bins = np.linspace(z.min(), z.max(), int(bins) + 1)
+        bins = np.asarray(bins, dtype='f8')
+
+        counts, _ = np.histogram(z, bins=bins, weights=w)
+
+        # comoving volume of each shell, scaled by fsky
+        r = cosmo.comoving_distance(bins)
+        dV = fsky * 4.0 / 3 * np.pi * np.diff(r ** 3)
+
+        self.bin_edges = bins
+        self.bin_centers = 0.5 * (bins[1:] + bins[:-1])
+        self.dV = dV
+        self.nbar = counts / dV
+
+        data = {'z': self.bin_centers, 'nbar': self.nbar,
+                'counts': counts, 'dV': dV}
+        self.hist = BinnedStatistic(['z'], [bins], data,
+                                    fields_to_sum=['counts', 'dV'])
+        self.hist.attrs.update(self.attrs)
+
+    def interpolate(self, z):
+        """n(z) interpolated at arbitrary redshifts (for building NZ
+        columns)."""
+        return np.interp(np.asarray(z), self.bin_centers, self.nbar,
+                         left=0.0, right=0.0)
+
+    def __getstate__(self):
+        return dict(bin_edges=self.bin_edges, nbar=self.nbar,
+                    dV=self.dV, attrs=self.attrs)

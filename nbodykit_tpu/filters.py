@@ -1,0 +1,32 @@
+"""Fourier-space mesh filters (reference: nbodykit/filters.py:5,35)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class TopHat(object):
+    """Spherical top-hat smoothing of radius r: multiplies delta_k by
+    the Fourier window 3 (sin x - x cos x) / x^3, x = k r."""
+
+    def __init__(self, r):
+        self.r = r
+
+    def __call__(self, k, v):
+        k2 = sum(ki ** 2 for ki in k)
+        kr = jnp.sqrt(k2) * self.r
+        krs = jnp.where(kr == 0, 1.0, kr)
+        w = 3.0 * (jnp.sin(krs) - krs * jnp.cos(krs)) / krs ** 3
+        w = jnp.where(kr == 0, 1.0, w)
+        return v * w
+
+
+class Gaussian(object):
+    """Gaussian smoothing of width r: multiplies delta_k by
+    exp(-(k r)^2 / 2)."""
+
+    def __init__(self, r):
+        self.r = r
+
+    def __call__(self, k, v):
+        k2 = sum(ki ** 2 for ki in k)
+        return v * jnp.exp(-0.5 * k2 * self.r ** 2)

@@ -34,3 +34,12 @@ from .algorithms.pair_counters import (SimulationBoxPairCount,  # noqa: F401,E40
                                        SurveyDataPairCount)
 from .algorithms.paircount_tpcf import (SimulationBox2PCF,  # noqa: F401,E402
                                         SurveyData2PCF)
+from .algorithms.threeptcf import SimulationBox3PCF, SurveyData3PCF  # noqa: F401,E402
+from .algorithms.kdtree import KDDensity  # noqa: F401,E402
+from .algorithms.zhist import RedshiftHistogram  # noqa: F401,E402
+from .algorithms.cgm import CylindricalGroups  # noqa: F401,E402
+from .algorithms.fibercollisions import FiberCollisions  # noqa: F401,E402
+from . import filters  # noqa: F401,E402
+from .filters import TopHat, Gaussian  # noqa: F401,E402
+from .hod import HODModel, Zheng07Model, HODModelFactory  # noqa: F401,E402
+from .batch import TaskManager  # noqa: F401,E402

@@ -1,0 +1,75 @@
+"""CylindricalGroups and FiberCollisions tests."""
+
+import numpy as np
+import pytest
+
+from nbodykit_tpu.lab import ArrayCatalog
+from nbodykit_tpu.algorithms.cgm import CylindricalGroups
+from nbodykit_tpu.algorithms.fibercollisions import FiberCollisions
+
+
+def test_cgm_basic():
+    # two "halos": a massive central with nearby satellites, plus an
+    # isolated object
+    pos = np.array([
+        [50.0, 50.0, 50.0],   # massive central
+        [50.5, 50.0, 50.0],   # satellite (dperp 0.5)
+        [50.0, 50.4, 51.0],   # satellite (dperp 0.4, dpar 1.0)
+        [20.0, 20.0, 20.0],   # isolated
+    ])
+    mass = np.array([10.0, 1.0, 1.0, 5.0])
+    cat = ArrayCatalog({'Position': pos, 'Mass': mass}, BoxSize=100.0)
+    cgm = CylindricalGroups(cat, rankby='Mass', rperp=1.0, rpar=2.0)
+    types = np.asarray(cgm.groups['cgm_type'])
+    hid = np.asarray(cgm.groups['cgm_haloid'])
+    assert types[0] == 0            # central with satellites
+    assert types[1] == 1 and hid[1] == 0
+    assert types[2] == 1 and hid[2] == 0
+    assert types[3] == 2            # isolated
+    assert np.asarray(cgm.groups['num_cgm_sats'])[0] == 2
+
+
+def test_cgm_rank_ordering():
+    # the *more massive* of two close objects becomes the central
+    pos = np.array([[10.0, 10.0, 10.0], [10.3, 10.0, 10.0]])
+    mass = np.array([1.0, 2.0])
+    cat = ArrayCatalog({'Position': pos, 'Mass': mass}, BoxSize=50.0)
+    cgm = CylindricalGroups(cat, rankby='Mass', rperp=1.0, rpar=1.0)
+    types = np.asarray(cgm.groups['cgm_type'])
+    assert types[1] == 0 and types[0] == 1
+    assert np.asarray(cgm.groups['cgm_haloid'])[0] == 1
+
+
+def test_fibercollisions_pair():
+    # two objects within the collision radius: exactly one collided
+    ra = np.array([10.0, 10.0 + 30. / 3600., 50.0])
+    dec = np.array([0.0, 0.0, 20.0])
+    fc = FiberCollisions(ra, dec, collision_radius=62. / 3600., seed=42)
+    coll = np.asarray(fc.labels['Collided'])
+    nid = np.asarray(fc.labels['NeighborID'])
+    assert coll[:2].sum() == 1
+    assert coll[2] == 0
+    i = int(np.flatnonzero(coll[:2])[0])
+    assert nid[i] == (i ^ 1)
+
+
+def test_fibercollisions_triplet_chain():
+    # three objects in a chain, spacing < radius: optimal assignment
+    # collides only the middle one
+    step = 40. / 3600.
+    ra = np.array([10.0, 10.0 + step, 10.0 + 2 * step])
+    dec = np.zeros(3)
+    fc = FiberCollisions(ra, dec, collision_radius=62. / 3600., seed=1)
+    coll = np.asarray(fc.labels['Collided'])
+    assert coll.sum() == 1
+    assert coll[1] == 1
+
+
+def test_fibercollisions_isolated():
+    rng = np.random.RandomState(3)
+    ra = rng.uniform(0, 360, 50)
+    dec = np.degrees(np.arcsin(rng.uniform(-0.5, 0.5, 50)))
+    fc = FiberCollisions(ra, dec, seed=2)
+    # at this sparsity nothing collides
+    assert np.asarray(fc.labels['Collided']).sum() == 0
+    assert np.all(np.asarray(fc.labels['NeighborID']) == -1)

@@ -1,0 +1,161 @@
+"""Tests for the smaller algorithms: 3PCF (brute-force triplet oracle),
+KDDensity, RedshiftHistogram, filters, HOD, TaskManager, FFTRecon."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from nbodykit_tpu.lab import (ArrayCatalog, UniformCatalog,
+                              LogNormalCatalog, LinearPower, Planck15,
+                              FFTPower)
+from nbodykit_tpu.algorithms.threeptcf import SimulationBox3PCF
+from nbodykit_tpu.algorithms.kdtree import KDDensity
+from nbodykit_tpu.algorithms.zhist import RedshiftHistogram
+from nbodykit_tpu.filters import TopHat, Gaussian
+from nbodykit_tpu.hod import HODModel, Zheng07Model
+from nbodykit_tpu.batch import TaskManager, split_ranks
+
+
+def brute_zeta(pos, w, edges, ell, box):
+    """Brute-force S_l(b1,b2) = sum_i w_i sum_{j in b1,k in b2} w_j w_k
+    P_l(cos theta_jik), periodic distances."""
+    from numpy.polynomial.legendre import legval
+    N = len(pos)
+    nb = len(edges) - 1
+    out = np.zeros((nb, nb))
+    c = np.zeros(ell + 1)
+    c[ell] = 1.0
+    for i in range(N):
+        d = pos - pos[i]
+        d -= np.round(d / box) * box
+        r = np.sqrt((d ** 2).sum(axis=-1))
+        sel = (r > 0) & (r >= edges[0]) & (r < edges[-1])
+        idx = np.flatnonzero(sel)
+        if len(idx) == 0:
+            continue
+        rv = d[idx] / r[idx][:, None]
+        bins = np.digitize(r[idx], edges) - 1
+        for a in range(len(idx)):
+            for b in range(len(idx)):
+                mu = np.clip(rv[a] @ rv[b], -1, 1)
+                out[bins[a], bins[b]] += w[i] * w[idx[a]] * w[idx[b]] \
+                    * legval(mu, c)
+    return out
+
+
+@pytest.mark.parametrize("ell", [0, 1, 2])
+def test_3pcf_brute_force(ell):
+    rng = np.random.RandomState(0)
+    pos = rng.uniform(0, 20.0, size=(60, 3))
+    w = rng.uniform(0.5, 1.5, size=60)
+    cat = ArrayCatalog({'Position': pos, 'Weight': w}, BoxSize=20.0)
+    edges = np.array([0.5, 4.0, 8.0])
+    r = SimulationBox3PCF(cat, poles=[ell], edges=edges)
+    want = brute_zeta(pos, w, edges, ell, 20.0)
+    np.testing.assert_allclose(np.asarray(r.poles['corr_%d' % ell]),
+                               want, rtol=1e-6, atol=1e-8)
+
+
+def test_kddensity():
+    rng = np.random.RandomState(1)
+    sparse = rng.uniform(0, 50.0, size=(200, 3))
+    cluster = 25.0 + rng.normal(0, 0.5, size=(200, 3))
+    pos = np.concatenate([sparse, cluster])
+    cat = ArrayCatalog({'Position': pos}, BoxSize=50.0)
+    kd = KDDensity(cat, margin=1.0)
+    rho = np.asarray(kd.density)
+    # clustered particles must be far denser than the sparse field
+    assert np.median(rho[200:]) > 10 * np.median(rho[:200])
+
+
+def test_redshift_histogram():
+    rng = np.random.RandomState(2)
+    z = rng.normal(0.5, 0.1, size=5000).clip(0.01, 1.0)
+    cat = ArrayCatalog({'Redshift': z})
+    h = RedshiftHistogram(cat, fsky=0.1, cosmo=Planck15)
+    assert h.nbar.shape == (len(h.bin_edges) - 1,)
+    # counts integrate back to N
+    np.testing.assert_allclose(h.hist['counts'].sum(), 5000)
+    # interpolation peaks near z ~ 0.5
+    zfine = np.linspace(0.05, 0.95, 181)
+    assert abs(zfine[np.argmax(h.interpolate(zfine))] - 0.5) < 0.1
+
+
+def test_filters_preserve_mean_and_smooth():
+    from nbodykit_tpu.lab import ArrayMesh
+    rng = np.random.RandomState(3)
+    field = rng.standard_normal((32, 32, 32)) + 5.0
+    mesh = ArrayMesh(field, BoxSize=32.0)
+    for filt in [TopHat(2.0), Gaussian(2.0)]:
+        sm = mesh.apply(filt, kind='wavenumber',
+                        mode='complex').compute(mode='real')
+        val = np.asarray(sm.value)
+        np.testing.assert_allclose(val.mean(), field.mean(), rtol=1e-6)
+        assert val.std() < field.std() * 0.5
+
+
+def test_hod_populate():
+    rng = np.random.RandomState(4)
+    Nh = 500
+    logM = rng.uniform(12.5, 15.0, Nh)
+    halos = ArrayCatalog({
+        'Mass': 10 ** logM,
+        'Position': rng.uniform(0, 100.0, size=(Nh, 3)),
+        'Velocity': rng.normal(0, 100, size=(Nh, 3))},
+        BoxSize=100.0)
+    model = HODModel(Zheng07Model(), seed=11)
+    gals = model.populate(halos)
+    assert gals.csize > Nh * 0.3
+    types = np.asarray(gals['gal_type'])
+    assert (types == 0).sum() > 0 and (types == 1).sum() > 0
+    pos = np.asarray(gals['Position'])
+    assert pos.min() >= 0 and pos.max() <= 100.0
+    # occupation increases with halo mass
+    occ = Zheng07Model()
+    assert occ.mean_ncen(1e15) > 0.99
+    assert occ.mean_ncen(1e12) < 0.05
+    assert occ.mean_nsat(1e15) > occ.mean_nsat(1e14)
+
+
+def test_hod_reproducible():
+    rng = np.random.RandomState(5)
+    halos = ArrayCatalog({
+        'Mass': 10 ** rng.uniform(13, 15, 100),
+        'Position': rng.uniform(0, 50.0, size=(100, 3)),
+        'Velocity': np.zeros((100, 3))}, BoxSize=50.0)
+    g1 = HODModel(seed=7).populate(halos)
+    g2 = HODModel(seed=7).populate(halos)
+    np.testing.assert_array_equal(np.asarray(g1['Position']),
+                                  np.asarray(g2['Position']))
+
+
+def test_split_ranks():
+    groups = list(split_ranks(8, 3))
+    assert groups[0] == (0, [0, 1, 2])
+    assert groups[-1] == (2, [6, 7])
+
+
+def test_task_manager():
+    with TaskManager(cpus_per_task=2) as tm:
+        results = tm.map(lambda x: x ** 2, range(5))
+    assert results == [0, 1, 4, 9, 16]
+    with TaskManager(cpus_per_task=1) as tm:
+        acc = [t for t in tm.iterate(range(3))]
+    assert acc == [0, 1, 2]
+
+
+def test_fftrecon_reduces_displacement():
+    # reconstruction should partially undo Zel'dovich displacements:
+    # the reconstructed field's large-scale power moves toward linear
+    from nbodykit_tpu.algorithms.fftrecon import FFTRecon
+    Plin = LinearPower(Planck15, 0.0)
+    Plin.sigma8 = 0.8
+    data = LogNormalCatalog(Plin=Plin, nbar=2e-3, BoxSize=200.,
+                            Nmesh=32, bias=1.5, seed=21)
+    ran = UniformCatalog(nbar=8e-3, BoxSize=200., seed=22)
+    recon = FFTRecon(data, ran, Nmesh=32, bias=1.5, R=15.0)
+    field = recon.compute(mode='real')
+    val = np.asarray(field.value)
+    assert np.isfinite(val).all()
+    # mean ~ 0 for an overdensity-difference field
+    assert abs(val.mean()) < 0.05
