@@ -18,6 +18,8 @@ from .power.linear import LinearPower, EHPower, NoWiggleEHPower
 from .power.halofit import HalofitPower
 from .power.zeldovich import ZeldovichPower
 from .correlation import (CorrelationFunction, pk_to_xi, xi_to_pk)
+from .power.galaxy import FNLGalaxyPower
+from .linearnbody import LinearNbody
 
 # Built-in parameter sets (flat LCDM fits; same fiducial values the
 # reference exposes)
@@ -36,4 +38,5 @@ __all__ = ['Cosmology', 'LinearPower', 'EHPower', 'NoWiggleEHPower',
            'HalofitPower', 'ZeldovichPower', 'CorrelationFunction',
            'pk_to_xi', 'xi_to_pk', 'Perturbation', 'MatterDominated',
            'RadiationDominated',
+           'FNLGalaxyPower', 'LinearNbody',
            'Planck13', 'Planck15', 'WMAP5', 'WMAP7', 'WMAP9']

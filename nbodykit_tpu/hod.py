@@ -115,7 +115,7 @@ class HODModel(object):
         if ntot_sat > 0:
             x = np.asarray(_sample_nfw_radius(
                 k_rad, conc[idx], ntot_sat))
-            dirs = np.asarray(jax.random.normal(k_dir, (ntot_sat, 3)))
+            dirs = np.array(jax.random.normal(k_dir, (ntot_sat, 3)))
             dirs /= np.linalg.norm(dirs, axis=-1, keepdims=True)
             sat_pos = pos[idx] + (x * rvir[idx])[:, None] * dirs
             # virial-scaled random velocities

@@ -43,3 +43,7 @@ from . import filters  # noqa: F401,E402
 from .filters import TopHat, Gaussian  # noqa: F401,E402
 from .hod import HODModel, Zheng07Model, HODModelFactory  # noqa: F401,E402
 from .batch import TaskManager  # noqa: F401,E402
+from .source.catalog.subvolumes import SubVolumesCatalog  # noqa: F401,E402
+from .cosmology import FNLGalaxyPower, LinearNbody  # noqa: F401,E402
+from .tutorials import DemoHaloCatalog  # noqa: F401,E402
+from . import meshtools  # noqa: F401,E402

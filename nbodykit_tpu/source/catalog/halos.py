@@ -101,5 +101,16 @@ class HaloCatalog(CatalogSource):
         E = float(self.cosmo.efunc(z))
         return self['Velocity'] * ((1.0 + z) / (100.0 * E))
 
+    def populate(self, model=None, seed=None, **params):
+        """Populate the halos with galaxies under an HOD model
+        (reference: source/catalog/halos.py:202-270 via halotools;
+        here nbodykit_tpu.hod natively)."""
+        from ...hod import HODModel, Zheng07Model
+        if model is None:
+            model = HODModel(Zheng07Model(**params), seed=seed)
+        elif not isinstance(model, HODModel):
+            model = HODModel(model, seed=seed)
+        return model.populate(self, seed=seed)
+
     def to_mesh(self, *args, **kwargs):
         return CatalogSource.to_mesh(self, *args, **kwargs)

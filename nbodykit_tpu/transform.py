@@ -105,3 +105,32 @@ def VectorProjection(vector, direction):
         (direction ** 2).sum(axis=-1, keepdims=True))
     amp = (vector * direction).sum(axis=-1, keepdims=True)
     return amp * direction
+
+
+# ---------------------------------------------------------------------------
+# halo property transforms (reference transform.py:376-487, there via
+# halotools; implemented analytically here)
+# ---------------------------------------------------------------------------
+
+def HaloRadius(mass, cosmo, redshift, mdef='vir'):
+    """Spherical-overdensity radius (Mpc/h) for halo masses (M_sun/h)."""
+    from .source.catalog.halos import halo_mass_definition
+    rho = halo_mass_definition(mdef, cosmo, redshift)
+    mass = jnp.asarray(mass)
+    return (3.0 * mass / (4 * np.pi * rho)) ** (1.0 / 3)
+
+
+def HaloConcentration(mass, cosmo, redshift, mdef='vir'):
+    """Dutton & Maccio 2014 concentration-mass relation."""
+    mass = jnp.asarray(mass)
+    z = redshift
+    b = -0.097 + 0.024 * z
+    a = 0.537 + (1.025 - 0.537) * np.exp(-0.718 * z ** 1.08)
+    return 10.0 ** (a + b * jnp.log10(mass / 1e12))
+
+
+def HaloVelocityDispersion(mass, cosmo, redshift, mdef='vir'):
+    """Virial velocity dispersion, km/s: sigma^2 ~ G M / (2 R)."""
+    G = 4.302e-9  # Mpc (km/s)^2 / M_sun (with h's cancelling)
+    R = HaloRadius(mass, cosmo, redshift, mdef)
+    return jnp.sqrt(G * jnp.asarray(mass) / (2.0 * R))

@@ -1,0 +1,34 @@
+"""Tutorial data helpers.
+
+Reference: ``nbodykit/tutorials/`` — DemoHaloCatalog downloads sample
+halo catalogs (halos.py:5) via a data mirror (wget.py:61-198). This
+environment has no network egress, so the demo catalog is *generated*:
+a reproducible FOF-halo-like catalog from a seeded lognormal mock,
+exposing the same columns (Position, Velocity, Mass).
+"""
+
+import numpy as np
+
+from ..source.catalog.array import ArrayCatalog
+
+
+def DemoHaloCatalog(simname='fake', halo_finder='fof', redshift=0.5,
+                    seed=42, comm=None):
+    """A reproducible demo halo catalog (generated, not downloaded)."""
+    rng = np.random.RandomState(seed)
+    BoxSize = 250.0
+    N = 5000
+    # mass function ~ power law tail
+    mass = 10 ** rng.uniform(12.0, 15.0, N)
+    pos = rng.uniform(0, BoxSize, size=(N, 3))
+    vel = rng.normal(0, 300.0, size=(N, 3))
+    cat = ArrayCatalog({'Position': pos, 'Velocity': vel,
+                        'Mass': mass}, comm=comm, BoxSize=BoxSize)
+    cat.attrs.update(simname=simname, halo_finder=halo_finder,
+                     redshift=redshift, seed=seed)
+    return cat
+
+
+def download_example_data(*args, **kwargs):
+    raise RuntimeError("this environment has no network egress; demo "
+                       "data is generated locally (DemoHaloCatalog)")

@@ -1,0 +1,104 @@
+"""Tests for the remaining surface: FNLGalaxyPower, LinearNbody, halo
+transforms, SubVolumesCatalog, meshtools, DemoHaloCatalog, HaloCatalog
+population, catalog ops (sort/gslice/concat)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from nbodykit_tpu.lab import (ArrayCatalog, UniformCatalog,
+                              Planck15, FNLGalaxyPower, LinearNbody,
+                              SubVolumesCatalog, DemoHaloCatalog,
+                              LinearPower)
+from nbodykit_tpu import transform
+from nbodykit_tpu.meshtools import SlabIterator
+
+
+def test_fnl_galaxy_power():
+    P0 = FNLGalaxyPower(Planck15, 0.5, b1=2.0, fnl=0.0)
+    P1 = FNLGalaxyPower(Planck15, 0.5, b1=2.0, fnl=50.0)
+    k = np.array([1e-3, 1e-2, 1e-1])
+    # fnl=0: P = b1^2 Plin
+    np.testing.assert_allclose(P0(k), 4.0 * P0.linear(k), rtol=1e-10)
+    # fnl > 0 with b1 > p boosts large scales most
+    boost = P1(k) / P0(k)
+    assert boost[0] > boost[1] > boost[2]
+    assert boost[0] > 1.5
+
+
+def test_linear_nbody():
+    ln = LinearNbody(Planck15)
+    rng = np.random.RandomState(0)
+    disp = rng.standard_normal((100, 3))
+    vel = rng.standard_normal((100, 3))
+    d2, v2 = ln.integrate(None, disp, vel, 0.5, 1.0)
+    D = Planck15.scale_independent_growth_factor
+    ratio = D(0.0) / D(1.0)  # a: 0.5 -> z=1; a=1 -> z=0
+    np.testing.assert_allclose(np.asarray(d2) / disp, ratio, rtol=0.02)
+    # forward then backward is identity
+    d3, v3 = ln.integrate(None, d2, v2, 1.0, 0.5)
+    np.testing.assert_allclose(np.asarray(d3), disp, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(v3), vel, rtol=1e-10)
+
+
+def test_halo_transforms():
+    M = np.array([1e12, 1e13, 1e14])
+    R = np.asarray(transform.HaloRadius(M, Planck15, 0.0))
+    assert np.all(np.diff(R) > 0)
+    assert 0.1 < R[1] < 1.0  # ~0.3-0.5 Mpc/h for 1e13
+    c = np.asarray(transform.HaloConcentration(M, Planck15, 0.0))
+    assert np.all(np.diff(c) < 0)  # decreasing with mass
+    sig = np.asarray(transform.HaloVelocityDispersion(M, Planck15, 0.0))
+    assert np.all(np.diff(sig) > 0)
+
+
+def test_subvolumes_catalog():
+    cat = UniformCatalog(nbar=1e-3, BoxSize=64.0, seed=3)
+    sub = SubVolumesCatalog(cat, domain=[2, 2, 2])
+    assert sub.csize == cat.csize
+    idx = np.asarray(sub['SubVolumeIndex'])
+    assert np.all(np.diff(idx) >= 0)  # sorted by subvolume
+    # particles in subvolume 0 live in the low corner
+    pos = np.asarray(sub['Position'])
+    first = pos[idx == 0]
+    assert np.all(first < 32.0)
+
+
+def test_demo_halo_catalog_and_populate():
+    from nbodykit_tpu.source.catalog.halos import HaloCatalog
+    demo = DemoHaloCatalog(seed=5)
+    halos = HaloCatalog(demo, cosmo=Planck15, redshift=0.5)
+    gals = halos.populate(seed=9)
+    assert gals.csize > 0
+    assert 'gal_type' in gals.columns
+
+
+def test_slab_iterator():
+    # coords of an 8^3 k-mesh, iterate slabs and accumulate mode count
+    N = 8
+    kx = np.fft.fftfreq(N, 1. / N).reshape(N, 1, 1)
+    ky = np.fft.fftfreq(N, 1. / N).reshape(1, N, 1)
+    kz = np.arange(N // 2 + 1).reshape(1, 1, N // 2 + 1)
+    total = 0.0
+    for slab in SlabIterator([kx, ky, kz], axis=0, symmetry_axis=2):
+        w = slab.hermitian_weights
+        total += np.sum(np.ones(slab.shape) * w)
+    assert total == N ** 3
+
+
+def test_catalog_sort_gslice_concat():
+    rng = np.random.RandomState(4)
+    cat = ArrayCatalog({'Mass': rng.uniform(size=50),
+                        'Position': rng.uniform(0, 10, (50, 3))},
+                       BoxSize=10.0)
+    s = cat.sort('Mass')
+    assert np.all(np.diff(np.asarray(s['Mass'])) >= 0)
+    sl = cat.gslice(10, 20)
+    assert sl.csize == 10
+    np.testing.assert_allclose(np.asarray(sl['Mass']),
+                               np.asarray(cat['Mass'])[10:20])
+    both = transform.ConcatenateSources(cat, cat)
+    assert both.csize == 100
+    # boolean selection
+    heavy = cat[np.asarray(cat['Mass']) > 0.5]
+    assert heavy.csize == int((np.asarray(cat['Mass']) > 0.5).sum())
