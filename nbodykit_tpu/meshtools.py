@@ -74,7 +74,12 @@ class MeshSlab(object):
 
     @property
     def hermitian_weights(self):
-        """Double-count weights for hermitian-compressed storage."""
+        """Double-count weights for hermitian-compressed storage.
+
+        Follows the reference convention that the symmetry-axis Nyquist
+        frequency carries a *negative* coordinate (weight 1); pass
+        coords accordingly (reference meshtools.py:188-215).
+        """
         if not self.hermitian_symmetric:
             return 1.0
         if self.symmetry_axis == self.axis:

@@ -78,7 +78,9 @@ def test_slab_iterator():
     N = 8
     kx = np.fft.fftfreq(N, 1. / N).reshape(N, 1, 1)
     ky = np.fft.fftfreq(N, 1. / N).reshape(1, N, 1)
-    kz = np.arange(N // 2 + 1).reshape(1, 1, N // 2 + 1)
+    # pmesh convention: the Nyquist frequency is stored negative so it
+    # gets hermitian weight 1 (see reference meshtools.py:188)
+    kz = np.array([0, 1, 2, 3, -4]).reshape(1, 1, N // 2 + 1)
     total = 0.0
     for slab in SlabIterator([kx, ky, kz], axis=0, symmetry_axis=2):
         w = slab.hermitian_weights
