@@ -135,3 +135,16 @@ def timer(name, logger=None):
 
 
 from .parallel.runtime import CurrentMesh, use_mesh, cpu_mesh, tpu_mesh  # noqa: E402,F401
+
+
+@contextmanager
+def profile(path='/tmp/nbodykit-tpu-trace', host=False):
+    """Capture a jax profiler trace of the enclosed block (SURVEY.md §5
+    'tracing': the reference has wall-clock phase logging only; here the
+    full XLA timeline lands in TensorBoard format at ``path``)."""
+    import jax
+    jax.profiler.start_trace(path)
+    try:
+        yield path
+    finally:
+        jax.profiler.stop_trace()

@@ -260,13 +260,22 @@ class CatalogSource(CatalogSourceBase):
         handles the distributed gather)."""
         if isinstance(keys, str):
             keys = [keys]
-        order = jnp.argsort(self[keys[-1]])
-        for key in reversed(keys[:-1]):
-            order = order[jnp.argsort(self[key][order], stable=True)]
-        if reverse:
-            order = order[::-1]
         cols = usecols or self.columns
         from ..source.catalog.array import ArrayCatalog
+        if len(keys) == 1 and self.comm is not None and \
+                mesh_size(self.comm) > 1 and not reverse:
+            # scalable path: distributed sample sort carrying a
+            # permutation payload (mpsort analog)
+            from ..parallel.sort import dist_sort
+            perm = jnp.arange(self._size)
+            _, order = dist_sort(self[keys[0]], perm, self.comm)
+        else:
+            order = jnp.argsort(self[keys[-1]])
+            for key in reversed(keys[:-1]):
+                order = order[jnp.argsort(self[key][order],
+                                          stable=True)]
+            if reverse:
+                order = order[::-1]
         data = {c: self[c][order] for c in cols}
         return ArrayCatalog(data, comm=self.comm, **self.attrs)
 

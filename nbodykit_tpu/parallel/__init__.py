@@ -10,10 +10,11 @@ from .runtime import CurrentMesh, use_mesh, cpu_mesh, tpu_mesh, single_device_me
 from .dfft import dist_rfftn, dist_irfftn, dist_fft_plan
 from .halo import halo_add, halo_fill
 from .exchange import exchange_by_dest, auto_capacity
+from .sort import dist_sort
 
 __all__ = [
     'CurrentMesh', 'use_mesh', 'cpu_mesh', 'tpu_mesh', 'single_device_mesh',
     'dist_rfftn', 'dist_irfftn', 'dist_fft_plan',
     'halo_add', 'halo_fill',
-    'exchange_by_dest', 'auto_capacity',
+    'exchange_by_dest', 'auto_capacity', 'dist_sort',
 ]

@@ -1,0 +1,149 @@
+"""Distributed sort: the mpsort replacement.
+
+Reference capability: ``mpsort.sort(data, orderby, comm)`` — a global
+parallel sort of structured arrays (consumed at base/catalog.py:1285,
+mockmaker.py:344, utils.py:640-647; SURVEY.md §2.2.4).
+
+TPU design — a sample sort inside one jitted shard_map program:
+
+1. local sort of each device's shard;
+2. P-quantile splitters sampled per device, all_gather'd, merged to
+   global splitters;
+3. bucket-by-splitter + fixed-capacity all_to_all;
+4. local sort of the received bucket (buckets are globally ordered
+   across devices);
+5. exact rebalance: each valid entry's global position follows from a
+   psum prefix of the per-device valid counts; a second capacity-nper
+   all_to_all ships every entry to position // nper, restoring an even
+   shard layout without loss.
+
+Sentinel caveat: the maximum representable key value is used as the
+padding sentinel; keys equal to it may be reordered among themselves.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .runtime import AXIS, mesh_size, shard_leading
+
+
+def dist_sort(keys, values=None, mesh=None, slack=2.0):
+    """Globally sort ``keys`` (and optionally reorder ``values`` the
+    same way). Returns evenly re-sharded global arrays.
+    """
+    nproc = mesh_size(mesh)
+    if nproc == 1:
+        order = jnp.argsort(keys)
+        if values is None:
+            return keys[order]
+        return keys[order], values[order]
+
+    N = keys.shape[0]
+    npad = (-N) % nproc
+    if jnp.issubdtype(keys.dtype, jnp.integer):
+        maxval = jnp.iinfo(keys.dtype).max
+    else:
+        maxval = jnp.asarray(jnp.inf, keys.dtype)
+    if npad:
+        keys = jnp.concatenate(
+            [keys, jnp.full(npad, maxval, keys.dtype)])
+        if values is not None:
+            values = jnp.concatenate(
+                [values, jnp.zeros((npad,) + values.shape[1:],
+                                   values.dtype)])
+    keys = shard_leading(mesh, keys)
+    if values is not None:
+        values = shard_leading(mesh, values)
+    nper = keys.shape[0] // nproc
+    capacity = int(np.ceil(nper / nproc * slack)) + 16
+
+    def exchange(arrs, dest, fills, cap):
+        """Ship per-device rows to dest buckets; returns receive
+        buffers of shape (nproc * cap, ...) + overflow count."""
+        n = dest.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        start = jnp.searchsorted(dest, jnp.arange(nproc,
+                                                  dtype=dest.dtype))
+        rank_in = idx - start[dest]
+        ok = rank_in < cap
+        over = jnp.sum(~ok)
+        slot = jnp.where(ok, dest * cap + rank_in, nproc * cap)
+        outs = []
+        for arr, fill in zip(arrs, fills):
+            buf = jnp.full((nproc * cap + 1,) + arr.shape[1:], fill,
+                           arr.dtype).at[slot].set(arr)
+            buf = buf[:-1].reshape((nproc, cap) + arr.shape[1:])
+            r = jax.lax.all_to_all(buf, AXIS, split_axis=0,
+                                   concat_axis=0, tiled=True)
+            outs.append(r.reshape((nproc * cap,) + r.shape[2:]))
+        return outs, over
+
+    def local(keys_l, *val_l):
+        order = jnp.argsort(keys_l)
+        ks = keys_l[order]
+        vs = [v[order] for v in val_l]
+
+        # global splitters from per-device quantiles
+        q = ks[jnp.linspace(0, ks.shape[0] - 1, nproc + 1)
+               .astype(jnp.int32)[1:-1]]
+        allq = jnp.sort(jax.lax.all_gather(q, AXIS).reshape(-1))
+        split = allq[jnp.arange(1, nproc) * (nproc - 1) // nproc] \
+            if nproc > 1 else allq[:0]
+        dest = jnp.searchsorted(split, ks, side='right').astype(
+            jnp.int32)
+
+        (krecv, *vrecv), over1 = exchange(
+            [ks] + vs, dest, [maxval] + [0] * len(vs), capacity)
+        order2 = jnp.argsort(krecv)
+        ks2 = krecv[order2]
+        vs2 = [v[order2] for v in vrecv]
+        valid = ks2 != maxval
+        cnt = jnp.sum(valid)
+
+        # exact rebalance by global position
+        counts = jax.lax.all_gather(cnt, AXIS)
+        me = jax.lax.axis_index(AXIS)
+        prefix = jnp.sum(jnp.where(jnp.arange(nproc) < me, counts, 0))
+        gpos = prefix + jnp.arange(ks2.shape[0])
+        dest2 = jnp.clip(gpos // nper, 0, nproc - 1).astype(jnp.int32)
+        # invalid entries: route to the last device's spare slots
+        dest2 = jnp.where(valid, dest2, nproc - 1)
+        # order by dest2 is already monotone for valid entries; put
+        # invalid at the end so ranks stay contiguous
+        reorder = jnp.argsort(jnp.where(valid, dest2, nproc))
+        ks3 = ks2[reorder]
+        vs3 = [v[reorder] for v in vs2]
+        dest3 = dest2[reorder]
+        (kfin, *vfin), over2 = exchange(
+            [ks3] + vs3, dest3, [maxval] + [0] * len(vs3),
+            max(nper, capacity))
+        order4 = jnp.argsort(kfin)
+        out_k = kfin[order4][:nper]
+        outs = [out_k] + [v[order4][:nper] for v in vfin]
+        dropped = jax.lax.psum(over1 + over2, AXIS)
+        return tuple(outs) + (dropped,)
+
+    vals = () if values is None else (values,)
+    in_specs = (P(AXIS),) + tuple(
+        P(*((AXIS,) + (None,) * (v.ndim - 1))) for v in vals)
+    out_specs = (P(AXIS),) + tuple(
+        P(*((AXIS,) + (None,) * (v.ndim - 1))) for v in vals) + (P(),)
+    res = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs)(keys, *vals)
+
+    dropped = int(res[-1])
+    if dropped > 0:
+        # pathological skew: exact single-device fallback
+        order = jnp.argsort(keys)
+        out = (keys[order],) if values is None else \
+            (keys[order], values[order])
+    else:
+        out = res[:-1]
+
+    if npad:
+        out = tuple(o[:N] for o in out)
+    if values is None:
+        return out[0]
+    return out[0], out[1]

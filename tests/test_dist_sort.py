@@ -1,0 +1,50 @@
+"""Distributed sort tests (mpsort-replacement; SURVEY.md §2.2.4)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from nbodykit_tpu.parallel.runtime import cpu_mesh
+from nbodykit_tpu.parallel.sort import dist_sort
+
+
+@pytest.mark.parametrize("N", [1000, 4096, 10001])
+def test_dist_sort_matches_numpy(N):
+    rng = np.random.RandomState(N)
+    keys = rng.randint(0, 1_000_000, N).astype(np.int64)
+    vals = rng.standard_normal((N, 2))
+    ks, vs = dist_sort(jnp.asarray(keys), jnp.asarray(vals), cpu_mesh())
+    np.testing.assert_array_equal(np.asarray(ks), np.sort(keys))
+    # values ride with their keys
+    uniq, cnts = np.unique(keys, return_counts=True)
+    got = dict(zip(np.asarray(ks).tolist(),
+                   np.round(np.asarray(vs), 9).tolist()))
+    for k in uniq[cnts == 1][:64]:
+        i = int(np.flatnonzero(keys == k)[0])
+        np.testing.assert_allclose(got[int(k)], vals[i], rtol=1e-9)
+
+
+def test_dist_sort_skewed_fallback():
+    keys = np.zeros(5000, dtype=np.int64)
+    keys[-7:] = np.arange(7)
+    ks = dist_sort(jnp.asarray(keys), mesh=cpu_mesh())
+    np.testing.assert_array_equal(np.asarray(ks), np.sort(keys))
+
+
+def test_dist_sort_floats():
+    rng = np.random.RandomState(1)
+    keys = rng.standard_normal(3000)
+    ks = dist_sort(jnp.asarray(keys), mesh=cpu_mesh())
+    np.testing.assert_allclose(np.asarray(ks), np.sort(keys))
+
+
+def test_catalog_sort_multi_device():
+    from nbodykit_tpu.lab import ArrayCatalog
+    from nbodykit_tpu.parallel.runtime import use_mesh
+    rng = np.random.RandomState(2)
+    with use_mesh(cpu_mesh()):
+        cat = ArrayCatalog({'Mass': rng.uniform(size=4096),
+                            'x': rng.uniform(size=4096)})
+        s = cat.sort('Mass')
+    m = np.asarray(s['Mass'])
+    assert np.all(np.diff(m) >= 0)
