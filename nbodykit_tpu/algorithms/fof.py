@@ -33,63 +33,32 @@ from ..base.catalog import CatalogSourceBase
 from ..utils import as_numpy
 
 
-def _fof_labels(pos, BoxSize, ll, K):
-    """Jittable FOF label computation.
+def _fof_labels(pos, BoxSize, ll, periodic=True):
+    """FOF label computation (jittable sweeps inside).
 
-    pos : (N, 3) positions; BoxSize : (3,) floats; ll : linking length
-    K : static per-cell capacity (max occupancy)
+    pos : (N, 3) positions (host/device); BoxSize : (3,) floats;
+    ll : linking length; periodic : wrap at the box boundary
 
     Returns (N,) int32 root labels (min particle index per group, in the
     cell-sorted ordering) mapped back to input order.
     """
+    from ..ops.gridhash import GridHash
     N = pos.shape[0]
-    box = jnp.asarray(BoxSize, pos.dtype)
-    ncell_np = np.clip(np.floor(np.asarray(BoxSize) / ll),
-                       1.0, 256.0).astype('i8')
-    ncell = jnp.asarray(ncell_np, jnp.int32)
-    cellsize = box / ncell
+    grid = GridHash(np.asarray(pos), BoxSize, ll, periodic=periodic,
+                    max_ncell=256)
+    order = jnp.asarray(grid.order)
+    pos_s = grid.pos_s
+    ci_s = grid.cell_of(pos_s)
 
-    ci = jnp.clip((pos / cellsize).astype(jnp.int32), 0, ncell - 1)
-    flat = (ci[:, 0] * ncell[1] + ci[:, 1]) * ncell[2] + ci[:, 2]
-    ncells_tot = int(np.prod(ncell_np))
-
-    order = jnp.argsort(flat)
-    flat_s = flat[order]
-    pos_s = pos[order]
-
-    # cell -> [start, end) ranges in the sorted order
-    start = jnp.searchsorted(flat_s, jnp.arange(ncells_tot,
-                                                dtype=flat_s.dtype))
-    count = jnp.searchsorted(flat_s, jnp.arange(ncells_tot,
-                                                dtype=flat_s.dtype),
-                             side='right') - start
-
-    # neighbor cells (periodic; offsets deduplicated for tiny grids)
-    from .pair_counters.core import neighbor_offsets
-    offs_list = neighbor_offsets(ncell_np)
-    offs = jnp.asarray(offs_list, dtype=jnp.int32)
-    ci_s = ci[order]
-
-    ll2 = jnp.asarray(ll * ll, pos.dtype)
+    ll2 = jnp.asarray(ll * ll, pos_s.dtype)
 
     def neighbor_min(labels):
         """For each particle: min label among particles within ll."""
         best = labels
-        for oi in range(len(offs_list)):
-            nc = jnp.mod(ci_s + offs[oi], ncell)
-            nflat = (nc[:, 0] * ncell[1] + nc[:, 1]) * ncell[2] + nc[:, 2]
-            s = start[nflat]
-            c = count[nflat]
-            for slot in range(K):
-                j = s + slot
-                valid = slot < c
-                j = jnp.where(valid, j, 0)
-                d = pos_s - pos_s[j]
-                d = d - jnp.round(d / box) * box  # periodic
-                r2 = jnp.sum(d * d, axis=-1)
-                ok = valid & (r2 <= ll2)
-                cand = jnp.where(ok, labels[j], best)
-                best = jnp.minimum(best, cand)
+        for j, valid, d, r2 in grid.sweep(pos_s, ci_s):
+            ok = valid & (r2 <= ll2)
+            cand = jnp.where(ok, labels[j], best)
+            best = jnp.minimum(best, cand)
         return best
 
     labels0 = jnp.arange(N, dtype=jnp.int32)
@@ -160,19 +129,10 @@ class FOF(object):
         self.labels = self.run()
 
     def run(self):
-        pos = self._source['Position']
+        pos = as_numpy(self._source['Position'])
         BoxSize = self.attrs['BoxSize']
-
-        # static per-cell capacity from the data (eager host computation)
-        ncell = np.clip(np.floor(BoxSize / self._ll), 1.0,
-                        256.0).astype('i8')
-        cellsize = BoxSize / ncell
-        ci = np.clip((as_numpy(pos) / cellsize).astype('i8'), 0,
-                     ncell - 1)
-        flat = (ci[:, 0] * ncell[1] + ci[:, 1]) * ncell[2] + ci[:, 2]
-        K = int(np.bincount(flat).max())
-
-        roots = _fof_labels(jnp.asarray(pos), BoxSize, self._ll, K)
+        roots = _fof_labels(pos, BoxSize, self._ll,
+                            periodic=self.attrs['periodic'])
 
         # compact + size-ordered halo labels (reference _assign_labels)
         roots_np = as_numpy(roots)
@@ -269,10 +229,11 @@ def fof_catalog(source, labels, nhalo, BoxSize, periodic=True,
         neg = jnp.full(nhalo, -jnp.inf, dtype=density.dtype)
         dmax = neg.at[labels].max(density)
         ispeak = density >= dmax[labels]
-        # first peak particle per halo
-        peak_idx = jnp.full(nhalo, 0, jnp.int32).at[
-            jnp.where(ispeak, labels, nhalo - 1)].max(
-            jnp.arange(len(labels), dtype=jnp.int32))
+        # peak particle per halo; non-peak particles scatter into a
+        # spare bucket (nhalo) so they cannot corrupt a real halo
+        peak_idx = jnp.zeros(nhalo + 1, jnp.int32).at[
+            jnp.where(ispeak, labels, nhalo)].max(
+            jnp.arange(len(labels), dtype=jnp.int32))[:nhalo]
         data['PeakPosition'] = pos[peak_idx]
         if 'Velocity' in source:
             data['PeakVelocity'] = jnp.asarray(

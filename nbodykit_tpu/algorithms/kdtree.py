@@ -47,43 +47,17 @@ class KDDensity(object):
         r = margin * mean_sep
         self.attrs['kernel_radius'] = r
 
-        from .pair_counters.core import _hash_secondary, neighbor_offsets
-        order, flat_s, ncell, cellsize, K = _hash_secondary(
-            pos, BoxSize, r)
-        offs_list = neighbor_offsets(ncell)
-        pos_s = jnp.asarray(pos[order])
-        ncells_tot = int(np.prod(ncell))
-        start = jnp.asarray(np.searchsorted(flat_s,
-                                            np.arange(ncells_tot)))
-        count = jnp.asarray(np.searchsorted(
-            flat_s, np.arange(ncells_tot), side='right')) - start
-
-        ncell_j = jnp.asarray(ncell, jnp.int32)
-        cellsize_j = jnp.asarray(cellsize)
-        boxj = jnp.asarray(BoxSize)
-        offs = jnp.asarray(offs_list, dtype=jnp.int32)
+        from ..ops.gridhash import GridHash
+        grid = GridHash(pos, BoxSize, r, periodic=True)
         r2 = r * r
 
         @jax.jit
         def neighbor_counts(p):
-            ci = jnp.clip((p / cellsize_j).astype(jnp.int32), 0,
-                          ncell_j - 1)
+            ci = grid.cell_of(p)
             total = jnp.zeros(p.shape[0])
-            for oi in range(len(offs_list)):
-                nc = jnp.mod(ci + offs[oi], ncell_j)
-                nflat = (nc[:, 0] * ncell_j[1] + nc[:, 1]) \
-                    * ncell_j[2] + nc[:, 2]
-                s = start[nflat]
-                c = count[nflat]
-                for slot in range(K):
-                    j = s + slot
-                    valid = slot < c
-                    j = jnp.where(valid, j, 0)
-                    d = p - pos_s[j]
-                    d = d - jnp.round(d / boxj) * boxj
-                    rr2 = jnp.sum(d * d, axis=-1)
-                    total = total + jnp.where(valid & (rr2 <= r2),
-                                              1.0, 0.0)
+            for j, valid, d, rr2 in grid.sweep(p, ci):
+                total = total + jnp.where(valid & (rr2 <= r2), 1.0,
+                                          0.0)
             return total
 
         counts_per = neighbor_counts(jnp.asarray(pos))

@@ -4,53 +4,19 @@ Replaces the Corrfunc C/AVX kernels the reference wraps
 (nbodykit/algorithms/pair_counters/corrfunc/*; SURVEY.md §2.3): weighted
 pair counts binned in r, (r, mu), (rp, pi), or theta.
 
-Design (same grid-hash pattern as algorithms/fof.py): hash the
-*secondary* set onto cells of size >= rmax, sort it by cell, and for
-each primary sweep the 27 neighbor cells with a static per-cell
-capacity K — every distance evaluation is a dense vectorized op, every
-histogram a bincount, all inside one jitted program. Cost is
-N1 * 27 * K; cells are rmax-sized so K tracks n2 * rmax^3.
-
-Primaries are processed in chunks (lax.map) to bound memory.
+Built on the shared grid-hash sweep (:class:`...ops.gridhash.GridHash`,
+also powering FOF/KDDensity/3PCF): hash the *secondary* set onto cells
+of size >= rmax, and for each primary chunk sweep the neighbor cells
+with a static per-cell capacity — every distance evaluation a dense
+vectorized op, every histogram a bincount, all inside one jitted
+program. Cost is N1 * len(offsets) * K.
 """
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-
-def neighbor_offsets(ncell, periodic=True):
-    """The neighbor-cell offset triples, deduplicated for tiny grids:
-    with n cells along an axis and periodic wrapping, offsets -1 and +1
-    alias to the same cell when n < 3 (and everything aliases to 0 when
-    n == 1) — visiting an aliased offset twice double-counts pairs."""
-    per_axis = []
-    for n in np.atleast_1d(ncell):
-        if periodic:
-            if n >= 3:
-                per_axis.append((-1, 0, 1))
-            elif n == 2:
-                per_axis.append((0, 1))
-            else:
-                per_axis.append((0,))
-        else:
-            per_axis.append((-1, 0, 1) if n >= 2 else (0,))
-    return [(i, j, k) for i in per_axis[0] for j in per_axis[1]
-            for k in per_axis[2]]
-
-
-def _hash_secondary(pos2, box, rmax):
-    """Sort the secondary set by rmax-sized cells; returns the sorted
-    arrays + cell lookup tables + static capacity K."""
-    ncell = np.maximum(np.floor(np.asarray(box) / rmax), 1).astype('i8')
-    ncell = np.minimum(ncell, 128)  # cap the table size
-    cellsize = np.asarray(box) / ncell
-    ci = np.clip((np.asarray(pos2) / cellsize).astype('i8'), 0,
-                 ncell - 1)
-    flat = (ci[:, 0] * ncell[1] + ci[:, 1]) * ncell[2] + ci[:, 2]
-    K = int(np.bincount(flat, minlength=int(np.prod(ncell))).max())
-    order = np.argsort(flat)
-    return order, flat[order], ncell, cellsize, K
+from ...ops.gridhash import GridHash, neighbor_offsets  # noqa: F401
 
 
 def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
@@ -70,7 +36,7 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
     pimax : max line-of-sight separation, with 1 Mpc/h pi bins, for
         mode='projected'
     los : line-of-sight axis index (0, 1, 2)
-    is_auto : self-pairs are excluded and every pair counted twice
+    is_auto : self-pairs are excluded; every pair counted twice
         (i<j and j>i), matching the reference's Corrfunc conventions
     grid_origin : (3,) offset subtracted before cell hashing (lets
         non-periodic data sit anywhere)
@@ -119,24 +85,9 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
         raise ValueError("unknown mode %r" % mode)
 
     nb1 = len(redges) - 1
-    order, flat_s, ncell, cellsize, K = _hash_secondary(p2, work_box,
-                                                       rmax)
-    pos2_s = jnp.asarray(p2[order])
-    w2_s = jnp.asarray(w2[order])
-    ncells_tot = int(np.prod(ncell))
-    start = jnp.asarray(
-        np.searchsorted(flat_s, np.arange(ncells_tot)))
-    count = jnp.asarray(
-        np.searchsorted(flat_s, np.arange(ncells_tot), side='right')
-        - np.searchsorted(flat_s, np.arange(ncells_tot)))
-
-    ncell_j = jnp.asarray(ncell, jnp.int32)
-    cellsize_j = jnp.asarray(cellsize)
-    boxj = jnp.asarray(work_box)
+    grid = GridHash(p2, work_box, rmax, periodic=periodic)
+    w2_s = jnp.asarray(w2[grid.order])
     r2edges = jnp.asarray(redges ** 2)
-    offs_list = neighbor_offsets(ncell, periodic=periodic)
-    offs = jnp.asarray(offs_list, dtype=jnp.int32)
-    use_wrap = bool(periodic)
     losj = int(los)
     origin_j = jnp.asarray(np.broadcast_to(
         np.asarray(grid_origin, dtype='f8'), (3,)))
@@ -144,66 +95,46 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
 
     def count_chunk(args):
         p1c, w1c, live1 = args  # (C, 3), (C,), (C,)
-        ci1 = jnp.clip((p1c / cellsize_j).astype(jnp.int32), 0,
-                       ncell_j - 1)
+        ci1 = grid.cell_of(p1c)
         npairs = jnp.zeros(nbins_flat, jnp.float64)
         wpairs = jnp.zeros(nbins_flat, jnp.float64)
-        for oi in range(len(offs_list)):
-            nc = ci1 + offs[oi]
-            if use_wrap:
-                nc = jnp.mod(nc, ncell_j)
+        for j, valid, dneg, r2 in grid.sweep(p1c, ci1):
+            d = -dneg  # primary - secondary, as the bins expect
+            # exclude exact self-pairs in autocorrelations
+            ok = live1 & valid & ((r2 > 0) if is_auto else (r2 >= 0))
+            dig_r = jnp.digitize(r2, r2edges)
+
+            if pair_los == 'midpoint' and mode in ('2d', 'projected'):
+                # observer at the (pre-shift) coordinate origin
+                mid = 0.5 * (p1c + grid.pos_s[j]) + origin_j
+                mnorm = jnp.sqrt(jnp.sum(mid * mid, axis=-1))
+                dlos = jnp.abs(jnp.sum(d * mid, axis=-1)) \
+                    / jnp.where(mnorm == 0, 1.0, mnorm)
             else:
-                nc = jnp.clip(nc, 0, ncell_j - 1)
-            oob = jnp.any((ci1 + offs[oi] != nc), axis=-1) if not \
-                use_wrap else jnp.zeros(p1c.shape[0], bool)
-            nflat = (nc[:, 0] * ncell_j[1] + nc[:, 1]) * ncell_j[2] \
-                + nc[:, 2]
-            s = start[nflat]
-            c = count[nflat]
-            for slot in range(K):
-                j = s + slot
-                valid = (slot < c) & ~oob
-                j = jnp.where(valid, j, 0)
-                d = p1c - pos2_s[j]
-                if use_wrap:
-                    d = d - jnp.round(d / boxj) * boxj
-                r2 = jnp.sum(d * d, axis=-1)
-                # exclude exact self-pairs in autocorrelations
-                ok = live1 & valid & ((r2 > 0) if is_auto else (r2 >= 0))
-                dig_r = jnp.digitize(r2, r2edges)
+                dlos = jnp.abs(d[:, losj])
 
-                if pair_los == 'midpoint' and mode in ('2d',
-                                                      'projected'):
-                    # observer at the (pre-shift) coordinate origin
-                    mid = 0.5 * (p1c + pos2_s[j]) + origin_j
-                    mnorm = jnp.sqrt(jnp.sum(mid * mid, axis=-1))
-                    dlos = jnp.abs(jnp.sum(d * mid, axis=-1)) \
-                        / jnp.where(mnorm == 0, 1.0, mnorm)
-                else:
-                    dlos = jnp.abs(d[:, losj])
+            if mode == '2d':
+                rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
+                mu = jnp.where(r2 == 0, 0.0, dlos / rr)
+                dig_2 = jnp.clip((mu * nb2).astype(jnp.int32), 0,
+                                 nb2 - 1)
+            elif mode == 'projected':
+                drp2 = r2 - dlos * dlos
+                dig_r = jnp.digitize(drp2, r2edges)
+                dig_2 = jnp.clip(dlos.astype(jnp.int32), 0, nb2 - 1)
+                ok = ok & (dlos < pimax)
+            else:
+                dig_2 = 0
 
-                if mode == '2d':
-                    rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
-                    mu = jnp.where(r2 == 0, 0.0, dlos / rr)
-                    dig_2 = jnp.clip((mu * nb2).astype(jnp.int32), 0,
-                                     nb2 - 1)
-                elif mode == 'projected':
-                    drp2 = r2 - dlos * dlos
-                    dig_r = jnp.digitize(drp2, r2edges)
-                    dig_2 = jnp.clip(dlos.astype(jnp.int32), 0, nb2 - 1)
-                    ok = ok & (dlos < pimax)
-                else:
-                    dig_2 = 0
-
-                idx = dig_r * nb2 + dig_2
-                # the overflow radial bin absorbs masked-out slots
-                idx = jnp.where(ok, idx, (nb1 + 1) * nb2)
-                npairs = npairs + jnp.bincount(
-                    idx, weights=jnp.where(ok, 1.0, 0.0),
-                    length=nbins_flat)
-                wpairs = wpairs + jnp.bincount(
-                    idx, weights=jnp.where(ok, w1c * w2_s[j], 0.0),
-                    length=nbins_flat)
+            idx = dig_r * nb2 + dig_2
+            # the overflow radial bin absorbs masked-out slots
+            idx = jnp.where(ok, idx, (nb1 + 1) * nb2)
+            npairs = npairs + jnp.bincount(
+                idx, weights=jnp.where(ok, 1.0, 0.0),
+                length=nbins_flat)
+            wpairs = wpairs + jnp.bincount(
+                idx, weights=jnp.where(ok, w1c * w2_s[j], 0.0),
+                length=nbins_flat)
         return npairs, wpairs
 
     N1 = len(p1)

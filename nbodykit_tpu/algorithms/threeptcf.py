@@ -47,25 +47,10 @@ class Base3PCF(object):
             box = np.ones(3) * np.asarray(BoxSize, dtype='f8')
             origin = np.zeros(3)
 
-        from .pair_counters.core import _hash_secondary
-        order, flat_s, ncell, cellsize, K = _hash_secondary(
-            pos - origin, box, rmax)
-        pos_s = jnp.asarray((pos - origin)[order])
-        w_s = jnp.asarray(w[order])
-        ncells_tot = int(np.prod(ncell))
-        start = jnp.asarray(np.searchsorted(
-            flat_s, np.arange(ncells_tot)))
-        count = jnp.asarray(np.searchsorted(
-            flat_s, np.arange(ncells_tot), side='right')) - start
-
-        ncell_j = jnp.asarray(ncell, jnp.int32)
-        cellsize_j = jnp.asarray(cellsize)
-        boxj = jnp.asarray(box)
+        from ..ops.gridhash import GridHash
+        grid = GridHash(pos - origin, box, rmax, periodic=periodic)
+        w_s = jnp.asarray(w[grid.order])
         r2edges = jnp.asarray(edges ** 2)
-        from .pair_counters.core import neighbor_offsets
-        offs_list = neighbor_offsets(ncell, periodic=periodic)
-        offs = jnp.asarray(offs_list, dtype=jnp.int32)
-        use_wrap = bool(periodic)
 
         ells = sorted(poles)
         ylms = [(ell, [get_real_Ylm(ell, m)
@@ -74,45 +59,27 @@ class Base3PCF(object):
         def chunk_zeta(args):
             p1c, w1c, live = args
             C = p1c.shape[0]
-            ci = jnp.clip((p1c / cellsize_j).astype(jnp.int32), 0,
-                          ncell_j - 1)
+            ci = grid.cell_of(p1c)
             # a_lm moments per (primary, lm, bin)
             nlm = sum(2 * ell + 1 for ell in ells)
             alm = jnp.zeros((C, nlm, nbins))
-            for oi in range(len(offs_list)):
-                nc = ci + offs[oi]
-                if use_wrap:
-                    nc = jnp.mod(nc, ncell_j)
-                else:
-                    nc = jnp.clip(nc, 0, ncell_j - 1)
-                nflat = (nc[:, 0] * ncell_j[1] + nc[:, 1]) \
-                    * ncell_j[2] + nc[:, 2]
-                s = start[nflat]
-                c = count[nflat]
-                for slot in range(K):
-                    j = s + slot
-                    valid = (slot < c) & live
-                    j = jnp.where(valid, j, 0)
-                    d = pos_s[j] - p1c
-                    if use_wrap:
-                        d = d - jnp.round(d / boxj) * boxj
-                    r2 = jnp.sum(d * d, axis=-1)
-                    ok = valid & (r2 > 1e-20)
-                    rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
-                    u = d / rr[:, None]
-                    dig = jnp.digitize(r2, r2edges) - 1
-                    inb = ok & (dig >= 0) & (dig < nbins)
-                    digc = jnp.clip(dig, 0, nbins - 1)
-                    wj = jnp.where(inb, w_s[j], 0.0)
-                    ilm = 0
-                    onehot = jax.nn.one_hot(digc, nbins) \
-                        * wj[:, None]  # (C, nbins)
-                    for ell, Ys in ylms:
-                        for Y in Ys:
-                            yv = Y(u[:, 0], u[:, 1], u[:, 2])
-                            alm = alm.at[:, ilm, :].add(
-                                yv[:, None] * onehot)
-                            ilm += 1
+            for j, valid, d, r2 in grid.sweep(p1c, ci):
+                ok = valid & live & (r2 > 1e-20)
+                rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
+                u = d / rr[:, None]
+                dig = jnp.digitize(r2, r2edges) - 1
+                inb = ok & (dig >= 0) & (dig < nbins)
+                digc = jnp.clip(dig, 0, nbins - 1)
+                wj = jnp.where(inb, w_s[j], 0.0)
+                ilm = 0
+                onehot = jax.nn.one_hot(digc, nbins) \
+                    * wj[:, None]  # (C, nbins)
+                for ell, Ys in ylms:
+                    for Y in Ys:
+                        yv = Y(u[:, 0], u[:, 1], u[:, 2])
+                        alm = alm.at[:, ilm, :].add(
+                            yv[:, None] * onehot)
+                        ilm += 1
             # zeta_l(b1,b2) = sum_i w_i (4pi/(2l+1)) sum_m alm alm^T
             outs = []
             ilm = 0

@@ -59,11 +59,17 @@ def _sample_nfw_radius(key, conc, n):
     # per-halo inverse CDF: vectorized via common x grid
     mgrid = m(x_grid[None, :], conc_np[:, None])
     mgrid = mgrid / mgrid[:, -1:]
-    # interp per row
-    out = np.empty(n)
+    # vectorized per-row inverse CDF: bracket u in each row, then
+    # linear-interpolate between the bracketing grid points
     u_np = np.asarray(u)
-    for i in range(n):
-        out[i] = np.interp(u_np[i], mgrid[i], x_grid)
+    j = (mgrid < u_np[:, None]).sum(axis=1)
+    j = np.clip(j, 1, len(x_grid) - 1)
+    rows = np.arange(n)
+    m_lo = mgrid[rows, j - 1]
+    m_hi = mgrid[rows, j]
+    t = np.where(m_hi > m_lo, (u_np - m_lo) / np.where(
+        m_hi > m_lo, m_hi - m_lo, 1.0), 0.0)
+    out = x_grid[j - 1] + t * (x_grid[j] - x_grid[j - 1])
     return jnp.asarray(out)
 
 

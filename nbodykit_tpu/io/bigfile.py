@@ -53,7 +53,6 @@ class BigFileWriter(object):
         }
         with open(os.path.join(bdir, 'header.json'), 'w') as ff:
             json.dump(header, ff)
-        flat = array.reshape(array.shape[0], -1) if array.ndim else array
         bounds = np.linspace(0, len(array), nfile + 1).astype(int)
         for i, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:])):
             with open(os.path.join(bdir, '%06d.bin' % i), 'wb') as ff:

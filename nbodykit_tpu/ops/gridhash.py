@@ -1,0 +1,122 @@
+"""The shared grid-hash neighbor sweep.
+
+One implementation of the cell-hash + 27-neighbor-offset + K-slot sweep
+that powers FOF, pair counting, KDDensity and the 3PCF (it was
+previously re-implemented in each; the non-periodic out-of-bounds guard
+now exists in exactly one place).
+
+Usage::
+
+    grid = GridHash(pos_secondary, box, rmax, periodic)   # host prep
+    ...
+    def kernel(pquery):                 # inside jit
+        ci = grid.cell_of(pquery)
+        for j, valid, d, r2 in grid.sweep(pquery, ci):
+            ...                         # accumulate
+
+``j`` indexes the *sorted* secondary arrays ``grid.pos_s`` (payloads
+must be pre-sorted with ``grid.order``); ``valid`` masks empty slots
+and out-of-bounds neighbor cells; ``d``/``r2`` are minimum-image when
+periodic.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def neighbor_offsets(ncell, periodic=True):
+    """Neighbor-cell offset triples, deduplicated for tiny grids: with n
+    cells along an axis and periodic wrapping, offsets -1 and +1 alias
+    to the same cell when n < 3 (and everything aliases at n == 1) —
+    visiting an aliased offset twice double-counts pairs."""
+    per_axis = []
+    for n in np.atleast_1d(ncell):
+        if periodic:
+            if n >= 3:
+                per_axis.append((-1, 0, 1))
+            elif n == 2:
+                per_axis.append((0, 1))
+            else:
+                per_axis.append((0,))
+        else:
+            per_axis.append((-1, 0, 1) if n >= 2 else (0,))
+    return [(i, j, k) for i in per_axis[0] for j in per_axis[1]
+            for k in per_axis[2]]
+
+
+class GridHash(object):
+    """Host-side preparation + jit-safe sweep over neighbor candidates.
+
+    Parameters
+    ----------
+    pos : (N, 2 or 3) secondary positions (host or device array)
+    box : (3,) domain size (the positions must lie in [0, box))
+    rmax : interaction radius; cells are >= rmax so 27 neighbors suffice
+    periodic : wrap at the box boundary (min-image distances)
+    max_ncell : per-axis cap on the cell table
+    """
+
+    def __init__(self, pos, box, rmax, periodic=True, max_ncell=128):
+        pos = np.asarray(pos, dtype='f8')
+        box = np.asarray(box, dtype='f8')
+        ncell = np.maximum(np.floor(box / rmax), 1).astype('i8')
+        ncell = np.minimum(ncell, max_ncell)
+        cellsize = box / ncell
+        ci = np.clip((pos / cellsize).astype('i8'), 0, ncell - 1)
+        flat = (ci[:, 0] * ncell[1] + ci[:, 1]) * ncell[2] + ci[:, 2]
+        ncells_tot = int(np.prod(ncell))
+        self.K = int(np.bincount(flat, minlength=ncells_tot).max()) \
+            if len(flat) else 1
+        order = np.argsort(flat)
+        starts = np.searchsorted(flat[order], np.arange(ncells_tot))
+        ends = np.searchsorted(flat[order], np.arange(ncells_tot),
+                               side='right')
+
+        self.periodic = bool(periodic)
+        self.ncell_np = ncell
+        self.order = order
+        self.offsets = neighbor_offsets(ncell, periodic=periodic)
+        self.pos_s = jnp.asarray(pos[order])
+        self.start = jnp.asarray(starts)
+        self.count = jnp.asarray(ends - starts)
+        self.ncell = jnp.asarray(ncell, jnp.int32)
+        self.cellsize = jnp.asarray(cellsize)
+        self.box = jnp.asarray(box)
+        self._offs = jnp.asarray(self.offsets, dtype=jnp.int32)
+
+    def cell_of(self, p):
+        """Cell triple of query positions (jit-safe)."""
+        return jnp.clip((p / self.cellsize).astype(jnp.int32), 0,
+                        self.ncell - 1)
+
+    def sweep(self, p, ci):
+        """Yield (j, valid, d, r2) for every (offset, slot) candidate.
+
+        j : indices into the grid's sorted secondary arrays
+        valid : bool — real candidate (slot occupied, cell in-bounds)
+        d : p_secondary[j] - p (min-image when periodic)
+        r2 : |d|^2
+        """
+        for oi in range(len(self.offsets)):
+            nc = ci + self._offs[oi]
+            if self.periodic:
+                nc = jnp.mod(nc, self.ncell)
+                oob = jnp.zeros(p.shape[0], bool)
+            else:
+                clipped = jnp.clip(nc, 0, self.ncell - 1)
+                oob = jnp.any(nc != clipped, axis=-1)
+                nc = clipped
+            nflat = (nc[:, 0] * self.ncell[1] + nc[:, 1]) \
+                * self.ncell[2] + nc[:, 2]
+            s = self.start[nflat]
+            c = self.count[nflat]
+            for slot in range(self.K):
+                j = s + slot
+                valid = (slot < c) & ~oob
+                j = jnp.where(valid, j, 0)
+                d = self.pos_s[j] - p
+                if self.periodic:
+                    d = d - jnp.round(d / self.box) * self.box
+                r2 = jnp.sum(d * d, axis=-1)
+                yield j, valid, d, r2

@@ -102,7 +102,7 @@ def paint_local(pos, mass, shape, resampler='cic', period=None, origin=0,
             [pos, jnp.zeros((npad - n, 3), pos.dtype)], axis=0)
         mass_p = jnp.concatenate(
             [mass, jnp.zeros((npad - n,), dtype)], axis=0)
-        pos_p = jnp.moveaxis(pos_p.reshape(nchunks, chunk, 3), 0, 0)
+        pos_p = pos_p.reshape(nchunks, chunk, 3)
         mass_p = mass_p.reshape(nchunks, chunk)
 
         def loop(i, flat):

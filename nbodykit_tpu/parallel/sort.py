@@ -59,16 +59,18 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
     nper = keys.shape[0] // nproc
     capacity = int(np.ceil(nper / nproc * slack)) + 16
 
-    def exchange(arrs, dest, fills, cap):
+    def exchange(arrs, dest, fills, cap, track=None):
         """Ship per-device rows to dest buckets; returns receive
-        buffers of shape (nproc * cap, ...) + overflow count."""
+        buffers of shape (nproc * cap, ...) + overflow count.
+        ``track`` masks which rows count as real data when they
+        overflow (sentinel padding never does)."""
         n = dest.shape[0]
         idx = jnp.arange(n, dtype=jnp.int32)
         start = jnp.searchsorted(dest, jnp.arange(nproc,
                                                   dtype=dest.dtype))
         rank_in = idx - start[dest]
         ok = rank_in < cap
-        over = jnp.sum(~ok)
+        over = jnp.sum(~ok if track is None else (~ok & track))
         slot = jnp.where(ok, dest * cap + rank_in, nproc * cap)
         outs = []
         for arr, fill in zip(arrs, fills):
@@ -89,13 +91,15 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
         q = ks[jnp.linspace(0, ks.shape[0] - 1, nproc + 1)
                .astype(jnp.int32)[1:-1]]
         allq = jnp.sort(jax.lax.all_gather(q, AXIS).reshape(-1))
-        split = allq[jnp.arange(1, nproc) * (nproc - 1) // nproc] \
+        # evenly spaced global splitters out of the P*(P-1) samples
+        split = allq[jnp.arange(1, nproc) * allq.shape[0] // nproc] \
             if nproc > 1 else allq[:0]
         dest = jnp.searchsorted(split, ks, side='right').astype(
             jnp.int32)
 
         (krecv, *vrecv), over1 = exchange(
-            [ks] + vs, dest, [maxval] + [0] * len(vs), capacity)
+            [ks] + vs, dest, [maxval] + [0] * len(vs), capacity,
+            track=(ks != maxval))
         order2 = jnp.argsort(krecv)
         ks2 = krecv[order2]
         vs2 = [v[order2] for v in vrecv]
@@ -108,7 +112,8 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
         prefix = jnp.sum(jnp.where(jnp.arange(nproc) < me, counts, 0))
         gpos = prefix + jnp.arange(ks2.shape[0])
         dest2 = jnp.clip(gpos // nper, 0, nproc - 1).astype(jnp.int32)
-        # invalid entries: route to the last device's spare slots
+        # invalid entries: route to the last device's spare slots (any
+        # overflow among them is harmless padding)
         dest2 = jnp.where(valid, dest2, nproc - 1)
         # order by dest2 is already monotone for valid entries; put
         # invalid at the end so ranks stay contiguous
@@ -116,9 +121,10 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
         ks3 = ks2[reorder]
         vs3 = [v[reorder] for v in vs2]
         dest3 = dest2[reorder]
+        valid3 = valid[reorder]
         (kfin, *vfin), over2 = exchange(
             [ks3] + vs3, dest3, [maxval] + [0] * len(vs3),
-            max(nper, capacity))
+            max(nper, capacity), track=valid3)
         order4 = jnp.argsort(kfin)
         out_k = kfin[order4][:nper]
         outs = [out_k] + [v[order4][:nper] for v in vfin]
@@ -134,6 +140,7 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
                         out_specs=out_specs)(keys, *vals)
 
     dropped = int(res[-1])
+    dist_sort._last_dropped = dropped  # introspection for tests
     if dropped > 0:
         # pathological skew: exact single-device fallback
         order = jnp.argsort(keys)

@@ -48,3 +48,11 @@ def test_catalog_sort_multi_device():
         s = cat.sort('Mass')
     m = np.asarray(s['Mass'])
     assert np.all(np.diff(m) >= 0)
+
+
+def test_dist_sort_fast_path_engages():
+    # balanced input must take the distributed path (no fallback)
+    rng = np.random.RandomState(7)
+    keys = rng.randint(0, 1 << 30, 4096).astype(np.int64)
+    dist_sort(jnp.asarray(keys), mesh=cpu_mesh())
+    assert dist_sort._last_dropped == 0

@@ -159,3 +159,59 @@ def test_fftrecon_reduces_displacement():
     assert np.isfinite(val).all()
     # mean ~ 0 for an overdensity-difference field
     assert abs(val.mean()) < 0.05
+
+
+def test_3pcf_nonperiodic_no_double_count():
+    # regression: boundary cells in the non-periodic path must not
+    # revisit aliased neighbor cells (SurveyData3PCF path)
+    pos = np.array([[0.1, 0.1, 0.1], [1.0, 0.1, 0.1],
+                    [9.9, 9.9, 9.9], [9.0, 9.9, 9.9]])
+    w = np.ones(4)
+    cat = ArrayCatalog({'Position': pos, 'Weight': w})
+    # BoxSize absent -> non-periodic bbox path
+    from nbodykit_tpu.algorithms.threeptcf import Base3PCF
+
+    class Direct(Base3PCF):
+        def __init__(self):
+            self.attrs = dict(poles=[0], edges=np.array([0.5, 1.5]))
+            self.poles = self._run(pos, w, np.array([0.5, 1.5]), [0],
+                                   BoxSize=None, periodic=False)
+
+    r = Direct()
+    # each point has exactly one neighbor at separation ~0.9-1.0:
+    # S_0 = sum_i w_i * (1*1) * P_0 = 4
+    np.testing.assert_allclose(np.asarray(r.poles['corr_0'])[0, 0],
+                               4.0, rtol=1e-6)
+
+
+def test_fof_nonperiodic():
+    from nbodykit_tpu.algorithms.fof import FOF
+    pos = np.array([[0.1, 50.0, 50.0], [99.9, 50.0, 50.0],
+                    [0.4, 50.0, 50.0]])
+    cat = ArrayCatalog({'Position': pos}, BoxSize=100.0)
+    f_per = FOF(cat, linking_length=0.5, nmin=1, absolute=True,
+                periodic=True)
+    f_non = FOF(cat, linking_length=0.5, nmin=1, absolute=True,
+                periodic=False)
+    lp = np.asarray(f_per.labels)
+    ln = np.asarray(f_non.labels)
+    assert lp[0] == lp[1] == lp[2]      # wraps: all one group
+    assert ln[0] == ln[2] != ln[1]      # no wrap: boundary separated
+
+
+def test_fof_peak_columns():
+    from nbodykit_tpu.algorithms.fof import FOF
+    rng = np.random.RandomState(6)
+    c1 = rng.normal(20, 0.3, size=(20, 3))
+    c2 = rng.normal(70, 0.3, size=(10, 3))
+    pos = np.concatenate([c1, c2])
+    dens = np.zeros(30)
+    dens[3] = 10.0   # peak of cluster 1
+    dens[25] = 7.0   # peak of cluster 2
+    cat = ArrayCatalog({'Position': pos, 'Density': dens},
+                       BoxSize=100.0)
+    fof = FOF(cat, linking_length=2.0, nmin=5, absolute=True)
+    feats = fof.find_features(peakcolumn='Density')
+    pk = np.asarray(feats['PeakPosition'])
+    np.testing.assert_allclose(pk[1], pos[3], rtol=1e-6)
+    np.testing.assert_allclose(pk[2], pos[25], rtol=1e-6)
