@@ -92,8 +92,11 @@ class CatalogMesh(MeshSource):
         if not self.interlaced:
             field = pm.paint(pos, mass, resampler=self.resampler)
         else:
-            # two meshes offset by half a cell, combined in k-space with
-            # the phase that re-centers the shifted one
+            # two meshes offset by half a cell, combined in k-space
+            # with the phase that re-centers the shifted one:
+            # paint(shift=0.5) deposits at cell coords x/H - 1/2, i.e.
+            # samples on the grid x = (j + 1/2) H, so its spectrum
+            # carries e^{+ik.H/2} and the combine multiplies e^{-ik.H/2}
             f1 = pm.paint(pos, mass, resampler=self.resampler)
             f2 = pm.paint(pos, mass, resampler=self.resampler, shift=0.5)
             c1 = pm.r2c(f1)
@@ -101,7 +104,7 @@ class CatalogMesh(MeshSource):
             kx, ky, kz = pm.k_list()
             H = pm.cellsize
             kH = kx * H[0] + ky * H[1] + kz * H[2]
-            combined = 0.5 * (c1 + c2 * jnp.exp(0.5j * kH))
+            combined = 0.5 * (c1 + c2 * jnp.exp(-0.5j * kH))
             field = pm.c2r(combined)
 
         # to host scalars for attrs (cheap; small reductions)
