@@ -41,6 +41,9 @@ _default_options = {
     'exchange_slack': 1.25,
     # default resampler window
     'resampler': 'cic',
+    # paint kernel: 'scatter' (chunked scatter-add) or 'sort'
+    # (scatter-free sort + segmented reduction; see ops/paint.py)
+    'paint_method': 'scatter',
 }
 _global_options.update(_default_options)
 
@@ -62,6 +65,8 @@ class set_options(object):
         capacity slack factor for the fixed-capacity particle exchange.
     resampler : str
         default window: 'nnb', 'cic', 'tsc', 'pcs'.
+    paint_method : str
+        'scatter' or 'sort' — the local deposit kernel.
     """
 
     def __init__(self, **kwargs):

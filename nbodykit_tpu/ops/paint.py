@@ -145,3 +145,62 @@ def readout_local(block, pos, resampler='cic', period=None, origin=0,
                             axis=0).reshape(nchunks, chunk, 3)
     vals = jax.lax.map(body, pos_p)
     return vals.reshape(-1)[:n]
+
+
+def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
+                      origin=0, out=None, npasses=None):
+    """Scatter-free paint: sort + segmented log-shift reduction + gather.
+
+    TPU scatter-add serializes on colliding indices; this variant never
+    scatters. All (cell, weight) deposit terms are concatenated with one
+    zero-weight sentinel per cell, sorted by cell, segment-summed with
+    log2(max-occupancy) shift-add passes (exact — no global cumsum, so
+    f32 precision is preserved), and the per-cell totals are *gathered*
+    at each cell's last occurrence (present by construction thanks to
+    the sentinels).
+
+    Memory is O(n * s^3 + M); prefer :func:`paint_local` (chunked
+    scatter) when that does not fit.
+
+    npasses : shift passes; must satisfy 2^npasses >= max terms per
+        cell (+1 sentinel). Default 22 covers 4M colliding terms.
+    """
+    n0l, N1, N2 = (int(x) for x in shape)
+    if period is None:
+        period = shape
+    period = tuple(int(p) for p in period)
+    n = pos.shape[0]
+    M = n0l * N1 * N2
+    dtype = out.dtype if out is not None else (
+        mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
+    mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
+    if npasses is None:
+        npasses = 22
+
+    lins = [jnp.arange(M, dtype=jnp.int32)]
+    ws = [jnp.zeros(M, dtype=dtype)]
+    for lin, w in _offset_terms(pos, mass, resampler, period, origin,
+                                n0l):
+        lins.append(lin.astype(jnp.int32))
+        ws.append(w.astype(dtype))
+    keys = jnp.concatenate(lins)
+    vals = jnp.concatenate(ws)
+    keys, vals = jax.lax.sort((keys, vals), num_keys=1)
+
+    # segmented inclusive prefix sums via log-shift passes: after the
+    # loop, the last element of each run holds the run total
+    total = keys.shape[0]
+    shift = 1
+    for _ in range(npasses):
+        if shift >= total:
+            break
+        same = keys[shift:] == keys[:-shift]
+        vals = vals.at[shift:].add(jnp.where(same, vals[:-shift], 0))
+        shift *= 2
+
+    ends = jnp.searchsorted(keys, jnp.arange(M, dtype=jnp.int32),
+                            side='right') - 1
+    block = vals[ends].astype(dtype).reshape(shape)
+    if out is not None:
+        block = out + block
+    return block

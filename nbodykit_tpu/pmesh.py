@@ -33,7 +33,7 @@ from .parallel import dfft
 from .parallel.halo import halo_add, halo_fill
 from .parallel.exchange import exchange_by_dest
 from .ops.window import window_support
-from .ops.paint import paint_local, readout_local
+from .ops.paint import paint_local, paint_local_sorted, readout_local
 
 
 def _triplet(x, dtype):
@@ -225,10 +225,13 @@ class ParticleMesh(object):
             jnp.asarray(mass, self.dtype), (npart,))
         chunk = _global_options['paint_chunk_size']
 
+        kernel = paint_local_sorted if \
+            _global_options['paint_method'] == 'sort' else \
+            (lambda *a, **kw: paint_local(*a, chunk=chunk, **kw))
         if self.nproc == 1:
-            block = paint_local(cpos, massa, self.shape_real,
-                                resampler=resampler, period=self.shape_real,
-                                origin=0, chunk=chunk)
+            block = kernel(cpos, massa, self.shape_real,
+                           resampler=resampler, period=self.shape_real,
+                           origin=0)
             out = block if out is None else out + block
             return out
 
@@ -246,9 +249,9 @@ class ParticleMesh(object):
         def local(cpos_l, mass_l):
             d = jax.lax.axis_index(AXIS)
             origin = d * n0 - h
-            ext = paint_local(cpos_l, mass_l, (n0 + 2 * h, N1, N2),
-                              resampler=resampler, period=(N0, N1, N2),
-                              origin=origin, chunk=chunk)
+            ext = kernel(cpos_l, mass_l, (n0 + 2 * h, N1, N2),
+                         resampler=resampler, period=(N0, N1, N2),
+                         origin=origin)
             return halo_add(ext, h, nproc)
 
         block = jax.shard_map(
