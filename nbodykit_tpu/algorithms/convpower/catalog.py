@@ -84,9 +84,9 @@ class FKPCatalog(MultipleSpeciesCatalog):
                 position='Position', bbox_from_species=None, nbar=None):
         """An FKPCatalogMesh painting data - alpha*randoms.
 
-        Note: the mesh is stored hermitian (real dtype); odd multipoles
-        with wide-angle effects need a full complex mesh (reference's
-        dtype='c16' path) — not yet implemented.
+        The mesh itself is stored real; ConvolvedFFTPower switches to
+        the full-complex (c2c) spectrum automatically when odd
+        multipoles are requested (the reference's dtype='c16' analog).
         """
         from .catalogmesh import FKPCatalogMesh
         if nbar is None:

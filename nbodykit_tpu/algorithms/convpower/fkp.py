@@ -11,10 +11,10 @@ associated-Legendre recurrence (:func:`get_real_Ylm`), so the whole
 Ylm-weight -> FFT -> Ylm-weight -> accumulate loop stays inside jitted
 XLA programs over the sharded mesh.
 
-Limitation mirroring our hermitian mesh layout: the density mesh is
-stored real (r2c hermitian), which is exact for even multipoles; the
-reference's full-complex (dtype='c16') path for odd multipoles under
-wide-angle effects is not yet implemented.
+Even multipoles ride the hermitian (r2c) fast path; requesting any odd
+multipole switches to the full complex (c2c) spectrum automatically —
+the analog of the reference's dtype='c16' mesh — since the hermitian
+shortcut is only exact for even ell under a varying line of sight.
 """
 
 import logging
@@ -151,6 +151,20 @@ class ConvolvedFFTPower(object):
         if 0 not in poles:
             poles = [0] + poles
 
+        # odd multipoles under wide-angle (varying line of sight) need
+        # the full complex spectrum — the hermitian (r2c) shortcut only
+        # holds for even ell (reference: the dtype='c16' path)
+        use_c2c = any(ell % 2 for ell in poles)
+        from ...parallel.dfft import dist_fftn_c2c
+
+        def forward(x):
+            if use_c2c:
+                return dist_fftn_c2c(x.astype(jnp.complex64
+                                     if pm.dtype.itemsize <= 4 else
+                                     jnp.complex128), pm.comm) \
+                    * (1.0 / pm.Ntot)
+            return pm.r2c(x)
+
         # the FKP density field
         rfield1 = self.first.compute(Nmesh=self.attrs['Nmesh'],
                                      mode='real')
@@ -159,9 +173,9 @@ class ConvolvedFFTPower(object):
 
         transfer = compensation_transfer(self.first.resampler,
                                          self.first.interlaced)
-        w_circ = pm.k_list(circular=True)
+        w_circ = pm.k_list(circular=True, full=use_c2c)
 
-        c1 = pm.r2c(rfield1.value)
+        c1 = forward(rfield1.value)
         c1 = transfer(w_circ, c1)
         A0_1 = c1 * volume
 
@@ -174,7 +188,7 @@ class ConvolvedFFTPower(object):
                 raise ValueError(
                     "cross-correlations require the same FKPCatalog "
                     "geometry (matching alpha)")
-            c2 = transfer(w_circ, pm.r2c(rfield2.value)) * volume
+            c2 = transfer(w_circ, forward(rfield2.value)) * volume
             A0_2 = c2
         else:
             rfield2 = rfield1
@@ -213,7 +227,7 @@ class ConvolvedFFTPower(object):
         xnorm = jnp.where(xnorm == 0, 1.0, xnorm)
         xh = [x / xnorm for x in xh]
 
-        kx, ky, kz = pm.k_list(dtype=jnp.float64)
+        kx, ky, kz = pm.k_list(dtype=jnp.float64, full=use_c2c)
         knorm = jnp.sqrt(kx ** 2 + ky ** 2 + kz ** 2)
         knorm = jnp.where(knorm == 0, jnp.inf, knorm)
         kh = [kx / knorm, ky / knorm, kz / knorm]
@@ -228,16 +242,19 @@ class ConvolvedFFTPower(object):
         muedges = np.linspace(-1, 1, 2)
         density2 = rfield2.value
 
+        cshape = (pm.shape_complex if not use_c2c else
+                  (int(pm.Nmesh[1]), int(pm.Nmesh[0]),
+                   int(pm.Nmesh[2])))
+
         def ell_term(ell):
             """Aell = sum_m FFT[F * Ylm(xh)] * Ylm(kh), compensated,
             * 4pi * volume — one jitted program per ell."""
-            Aell = jnp.zeros(pm.shape_complex,
-                             dtype=A0_1.dtype)
+            Aell = jnp.zeros(cshape, dtype=A0_1.dtype)
             for m in range(-ell, ell + 1):
                 Ylm = get_real_Ylm(ell, m)
                 wx = Ylm(xh[0], xh[1], xh[2])
                 r = density2 * wx.astype(density2.dtype)
-                ck = pm.r2c(r)
+                ck = forward(r)
                 wk = Ylm(kh[0], kh[1], kh[2])
                 Aell = Aell + ck * wk
             Aell = transfer(w_circ, Aell)

@@ -66,7 +66,11 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
     odd multipoles keep 2i*Im, even keep 2*Re on the doubled modes.
     """
     pm = y3d.pm
-    hermitian = (y3d.kind == 'complex')
+    # a complex field with the full (uncompressed) kz axis is a c2c
+    # spectrum: all modes present, no hermitian double-counting
+    full_complex = (y3d.kind == 'complex'
+                    and y3d.shape[2] == int(pm.Nmesh[2]))
+    hermitian = (y3d.kind == 'complex') and not full_complex
     xedges, muedges = edges
     Nx = len(xedges) - 1
     Nmu = len(muedges) - 1
@@ -80,14 +84,18 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
 
     nbins = (Nx + 2) * (Nmu + 2)
 
-    if hermitian:
-        kx, ky, kz = pm.k_list(dtype=jnp.float64)
+    if hermitian or full_complex:
+        kx, ky, kz = pm.k_list(dtype=jnp.float64, full=full_complex)
         coords = [kx * los[0], ky * los[1], kz * los[2]]
         x2 = kx ** 2 + ky ** 2 + kz ** 2
-        w = pm.hermitian_weights(dtype=jnp.float64)
-        w = jnp.broadcast_to(w, y3d.shape)
-        # doubled (nonsingular) modes: exactly the weight-2 modes
-        nonsingular = (w == 2.0)
+        if full_complex:
+            w = jnp.ones(y3d.shape, dtype=jnp.float64)
+            nonsingular = jnp.zeros(y3d.shape, dtype=bool)
+        else:
+            w = pm.hermitian_weights(dtype=jnp.float64)
+            w = jnp.broadcast_to(w, y3d.shape)
+            # doubled (nonsingular) modes: exactly the weight-2 modes
+            nonsingular = (w == 2.0)
     else:
         # real field: separation coordinates in fftfreq ordering
         N0, N1, N2 = pm.shape_real

@@ -138,13 +138,14 @@ class ParticleMesh(object):
                         * jnp.asarray(h, dtype)).reshape(shape))
         return out
 
-    def k_list(self, dtype=None, circular=False):
+    def k_list(self, dtype=None, circular=False, full=False):
         """Broadcastable k-coordinate arrays [kx, ky, kz] for the
         *transposed* complex layout (axis0=ky, axis1=kx, axis2=kz).
 
         ``circular=True`` gives w_i = k_i * BoxSize_i / Nmesh_i in
         [-pi, pi) (the reference's 'circular' apply kind,
-        nbodykit/base/mesh.py:132-145).
+        nbodykit/base/mesh.py:132-145). ``full=True`` gives the
+        uncompressed kz axis (c2c layout) instead of the rfft half.
         """
         dtype = dtype or (jnp.float32 if self.dtype.itemsize <= 4
                           else jnp.float64)
@@ -152,7 +153,7 @@ class ParticleMesh(object):
         L = self.BoxSize
 
         def freq(n, L_i, r2c_axis=False):
-            if r2c_axis:
+            if r2c_axis and not full:
                 j = jnp.arange(n // 2 + 1, dtype=dtype)
             else:
                 j = jnp.fft.fftfreq(n, d=1.0 / n).astype(dtype)
@@ -162,7 +163,8 @@ class ParticleMesh(object):
 
         kx = freq(N0, L[0]).reshape(1, N0, 1)
         ky = freq(N1, L[1]).reshape(N1, 1, 1)
-        kz = freq(N2, L[2], r2c_axis=True).reshape(1, 1, N2 // 2 + 1)
+        nz = N2 if full else N2 // 2 + 1
+        kz = freq(N2, L[2], r2c_axis=True).reshape(1, 1, nz)
         return [kx, ky, kz]
 
     def i_list_complex(self):

@@ -131,3 +131,18 @@ def test_multiple_species_basic():
                                np.asarray(c1['Position']))
     cat['a/Extra'] = np.ones(c1.csize)
     assert 'Extra' in c1.columns
+
+
+def test_convpower_odd_poles_c2c(fkp_setup):
+    # requesting an odd pole switches to the full-complex spectrum; the
+    # even poles must agree with the hermitian fast path
+    _, data, r_even = fkp_setup
+    mesh = r_even.first
+    r_odd = ConvolvedFFTPower(mesh, poles=[0, 1, 2], dk=0.02, kmin=0.02)
+    p0e = r_even.poles['power_0'].real
+    p0o = r_odd.poles['power_0'].real
+    sel = np.isfinite(p0e) & (np.abs(p0e) > 1)
+    np.testing.assert_allclose(p0o[sel], p0e[sel], rtol=1e-10)
+    # dipole of a (nearly) periodic box sample is tiny compared to P0
+    p1 = r_odd.poles['power_1'].real
+    assert np.nanmax(np.abs(p1[sel])) < 0.1 * np.nanmax(np.abs(p0e[sel]))
