@@ -54,12 +54,11 @@ def _fof_labels(pos, BoxSize, ll, periodic=True):
 
     def neighbor_min(labels):
         """For each particle: min label among particles within ll."""
-        best = labels
-        for j, valid, d, r2 in grid.sweep(pos_s, ci_s):
+        def body(best, j, valid, d, r2):
             ok = valid & (r2 <= ll2)
             cand = jnp.where(ok, labels[j], best)
-            best = jnp.minimum(best, cand)
-        return best
+            return jnp.minimum(best, cand)
+        return grid.fold(pos_s, ci_s, body, labels)
 
     labels0 = jnp.arange(N, dtype=jnp.int32)
 

@@ -54,11 +54,9 @@ class KDDensity(object):
         @jax.jit
         def neighbor_counts(p):
             ci = grid.cell_of(p)
-            total = jnp.zeros(p.shape[0])
-            for j, valid, d, rr2 in grid.sweep(p, ci):
-                total = total + jnp.where(valid & (rr2 <= r2), 1.0,
-                                          0.0)
-            return total
+            def body(total, j, valid, d, rr2):
+                return total + jnp.where(valid & (rr2 <= r2), 1.0, 0.0)
+            return grid.fold(p, ci, body, jnp.zeros(p.shape[0]))
 
         counts_per = neighbor_counts(jnp.asarray(pos))
         vol = 4.0 / 3 * np.pi * r ** 3

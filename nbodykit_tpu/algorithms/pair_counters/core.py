@@ -98,7 +98,9 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
         ci1 = grid.cell_of(p1c)
         npairs = jnp.zeros(nbins_flat, jnp.float64)
         wpairs = jnp.zeros(nbins_flat, jnp.float64)
-        for j, valid, dneg, r2 in grid.sweep(p1c, ci1):
+
+        def body(carry, j, valid, dneg, r2):
+            npairs, wpairs = carry
             d = -dneg  # primary - secondary, as the bins expect
             # exclude exact self-pairs in autocorrelations
             ok = live1 & valid & ((r2 > 0) if is_auto else (r2 >= 0))
@@ -135,7 +137,9 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
             wpairs = wpairs + jnp.bincount(
                 idx, weights=jnp.where(ok, w1c * w2_s[j], 0.0),
                 length=nbins_flat)
-        return npairs, wpairs
+            return npairs, wpairs
+
+        return grid.fold(p1c, ci1, body, (npairs, wpairs))
 
     N1 = len(p1)
     nchunks = max(1, (N1 + chunk - 1) // chunk)

@@ -62,8 +62,9 @@ class Base3PCF(object):
             ci = grid.cell_of(p1c)
             # a_lm moments per (primary, lm, bin)
             nlm = sum(2 * ell + 1 for ell in ells)
-            alm = jnp.zeros((C, nlm, nbins))
-            for j, valid, d, r2 in grid.sweep(p1c, ci):
+            alm0 = jnp.zeros((C, nlm, nbins))
+
+            def body(alm, j, valid, d, r2):
                 ok = valid & live & (r2 > 1e-20)
                 rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
                 u = d / rr[:, None]
@@ -71,15 +72,16 @@ class Base3PCF(object):
                 inb = ok & (dig >= 0) & (dig < nbins)
                 digc = jnp.clip(dig, 0, nbins - 1)
                 wj = jnp.where(inb, w_s[j], 0.0)
-                ilm = 0
                 onehot = jax.nn.one_hot(digc, nbins) \
                     * wj[:, None]  # (C, nbins)
+                yvs = []
                 for ell, Ys in ylms:
                     for Y in Ys:
-                        yv = Y(u[:, 0], u[:, 1], u[:, 2])
-                        alm = alm.at[:, ilm, :].add(
-                            yv[:, None] * onehot)
-                        ilm += 1
+                        yvs.append(Y(u[:, 0], u[:, 1], u[:, 2]))
+                yv = jnp.stack(yvs, axis=1)  # (C, nlm)
+                return alm + yv[:, :, None] * onehot[:, None, :]
+
+            alm = grid.fold(p1c, ci, body, alm0)
             # zeta_l(b1,b2) = sum_i w_i (4pi/(2l+1)) sum_m alm alm^T
             outs = []
             ilm = 0

@@ -90,8 +90,34 @@ class GridHash(object):
         return jnp.clip((p / self.cellsize).astype(jnp.int32), 0,
                         self.ncell - 1)
 
+    def _offset_tables(self, p, ci, oi):
+        """(start, count, oob) of the oi-th neighbor cell per query."""
+        nc = ci + self._offs[oi]
+        if self.periodic:
+            nc = jnp.mod(nc, self.ncell)
+            oob = jnp.zeros(p.shape[0], bool)
+        else:
+            clipped = jnp.clip(nc, 0, self.ncell - 1)
+            oob = jnp.any(nc != clipped, axis=-1)
+            nc = clipped
+        nflat = (nc[:, 0] * self.ncell[1] + nc[:, 1]) \
+            * self.ncell[2] + nc[:, 2]
+        return self.start[nflat], self.count[nflat], oob
+
+    def _candidate(self, p, s, c, oob, slot):
+        j = s + slot
+        valid = (slot < c) & ~oob
+        j = jnp.where(valid, j, 0)
+        d = self.pos_s[j] - p
+        if self.periodic:
+            d = d - jnp.round(d / self.box) * self.box
+        r2 = jnp.sum(d * d, axis=-1)
+        return j, valid, d, r2
+
     def sweep(self, p, ci):
-        """Yield (j, valid, d, r2) for every (offset, slot) candidate.
+        """Yield (j, valid, d, r2) for every (offset, slot) candidate —
+        unrolled; prefer :meth:`fold` (fori_loop over slots, compiles
+        once regardless of the occupancy K).
 
         j : indices into the grid's sorted secondary arrays
         valid : bool — real candidate (slot occupied, cell in-bounds)
@@ -99,24 +125,20 @@ class GridHash(object):
         r2 : |d|^2
         """
         for oi in range(len(self.offsets)):
-            nc = ci + self._offs[oi]
-            if self.periodic:
-                nc = jnp.mod(nc, self.ncell)
-                oob = jnp.zeros(p.shape[0], bool)
-            else:
-                clipped = jnp.clip(nc, 0, self.ncell - 1)
-                oob = jnp.any(nc != clipped, axis=-1)
-                nc = clipped
-            nflat = (nc[:, 0] * self.ncell[1] + nc[:, 1]) \
-                * self.ncell[2] + nc[:, 2]
-            s = self.start[nflat]
-            c = self.count[nflat]
+            s, c, oob = self._offset_tables(p, ci, oi)
             for slot in range(self.K):
-                j = s + slot
-                valid = (slot < c) & ~oob
-                j = jnp.where(valid, j, 0)
-                d = self.pos_s[j] - p
-                if self.periodic:
-                    d = d - jnp.round(d / self.box) * self.box
-                r2 = jnp.sum(d * d, axis=-1)
-                yield j, valid, d, r2
+                yield self._candidate(p, s, c, oob, slot)
+
+    def fold(self, p, ci, body, carry):
+        """Accumulate ``carry = body(carry, j, valid, d, r2)`` over all
+        candidates, with the K-slot loop as a lax.fori_loop (constant
+        compile cost in K; the ~27 offsets stay unrolled)."""
+        for oi in range(len(self.offsets)):
+            s, c, oob = self._offset_tables(p, ci, oi)
+
+            def slot_body(slot, carry):
+                j, valid, d, r2 = self._candidate(p, s, c, oob, slot)
+                return body(carry, j, valid, d, r2)
+
+            carry = jax.lax.fori_loop(0, self.K, slot_body, carry)
+        return carry
