@@ -129,12 +129,6 @@ def run_config(Nmesh, Npart, resampler='cic', paint_method='scatter'):
 
 
 def main():
-    try:
-        method = autotune_paint()
-    except Exception as e:
-        print("autotune failed (%s); using scatter" % str(e)[:120],
-              file=sys.stderr)
-        method = 'scatter'
     configs = [
         (1024, 100_000_000),
         (1024, 10_000_000),
@@ -143,6 +137,16 @@ def main():
         (128, 100_000),
     ]
     for Nmesh, Npart in configs:
+        # autotune at the config's own scale (capped probe size): the
+        # sort kernel's memory/cost profile changes with Nmesh/Npart,
+        # so a small-probe winner must not be forced on large configs
+        try:
+            method = autotune_paint(Nmesh=Nmesh,
+                                    Npart=min(Npart, 5_000_000))
+        except Exception as e:
+            print("autotune failed (%s); using scatter" % str(e)[:120],
+                  file=sys.stderr)
+            method = 'scatter'
         try:
             dt = run_config(Nmesh, Npart, paint_method=method)
             metric = "fftpower_wallclock_nmesh%d_npart%.0e" % (Nmesh, Npart)

@@ -145,7 +145,7 @@ class CylindricalGroups(object):
         for idx, f in enumerate(flat):
             cells[int(f)].append(idx)
 
-        from .pair_counters.core import neighbor_offsets
+        from ..ops.gridhash import neighbor_offsets
         offs = neighbor_offsets(ncell, periodic=periodic)
         pairs = set()
         for f, members in cells.items():

@@ -16,7 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...ops.gridhash import GridHash, neighbor_offsets  # noqa: F401
+from ...ops.gridhash import GridHash
 
 
 def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,

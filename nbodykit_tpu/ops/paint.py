@@ -154,16 +154,20 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     TPU scatter-add serializes on colliding indices; this variant never
     scatters. All (cell, weight) deposit terms are concatenated with one
     zero-weight sentinel per cell, sorted by cell, segment-summed with
-    log2(max-occupancy) shift-add passes (exact — no global cumsum, so
-    f32 precision is preserved), and the per-cell totals are *gathered*
-    at each cell's last occurrence (present by construction thanks to
-    the sentinels).
+    doubling shift-add passes (exact — no global cumsum, so f32
+    precision is preserved), and the per-cell totals are *gathered* at
+    each cell's last occurrence (present by construction thanks to the
+    sentinels).
+
+    The shift loop runs as a lax.while_loop until no segment spans the
+    current shift, so arbitrarily long collision runs are summed exactly
+    (cost: log2(max occupancy) passes).
 
     Memory is O(n * s^3 + M); prefer :func:`paint_local` (chunked
     scatter) when that does not fit.
 
-    npasses : shift passes; must satisfy 2^npasses >= max terms per
-        cell (+1 sentinel). Default 22 covers 4M colliding terms.
+    npasses : optional static cap on the doubling passes (mostly for
+        testing); None iterates to completion.
     """
     n0l, N1, N2 = (int(x) for x in shape)
     if period is None:
@@ -174,8 +178,6 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     dtype = out.dtype if out is not None else (
         mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
     mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
-    if npasses is None:
-        npasses = 22
 
     lins = [jnp.arange(M, dtype=jnp.int32)]
     ws = [jnp.zeros(M, dtype=dtype)]
@@ -187,16 +189,30 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     vals = jnp.concatenate(ws)
     keys, vals = jax.lax.sort((keys, vals), num_keys=1)
 
-    # segmented inclusive prefix sums via log-shift passes: after the
-    # loop, the last element of each run holds the run total
+    # segmented inclusive prefix sums via doubling shift-add passes:
+    # afterwards the last element of each equal-key run holds the run
+    # total. Dynamic shifts use index arithmetic (gathers) so the loop
+    # can run until no run spans the current shift.
     total = keys.shape[0]
-    shift = 1
-    for _ in range(npasses):
-        if shift >= total:
-            break
-        same = keys[shift:] == keys[:-shift]
-        vals = vals.at[shift:].add(jnp.where(same, vals[:-shift], 0))
-        shift *= 2
+    idx = jnp.arange(total, dtype=jnp.int32)
+    max_shift = total if npasses is None else min(total, 1 << npasses)
+
+    def cond(state):
+        vals, shift, active = state
+        return active & (shift < max_shift)
+
+    def body(state):
+        vals, shift, _ = state
+        src = jnp.maximum(idx - shift, 0)
+        same = (idx >= shift) & (keys == keys[src])
+        vals = vals + jnp.where(same, vals[src], 0)
+        # another pass is needed iff some run still spans 2*shift
+        src2 = jnp.maximum(idx - 2 * shift, 0)
+        active = jnp.any((idx >= 2 * shift) & (keys == keys[src2]))
+        return vals, shift * 2, active
+
+    vals, _, _ = jax.lax.while_loop(
+        cond, body, (vals, jnp.int32(1), jnp.asarray(True)))
 
     ends = jnp.searchsorted(keys, jnp.arange(M, dtype=jnp.int32),
                             side='right') - 1

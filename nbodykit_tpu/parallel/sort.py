@@ -35,6 +35,7 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
     """
     nproc = mesh_size(mesh)
     if nproc == 1:
+        dist_sort._last_dropped = 0
         order = jnp.argsort(keys)
         if values is None:
             return keys[order]
