@@ -6,7 +6,7 @@ paint -> rfft -> window compensation -> |delta_k|^2 -> (k, mu) binning —
 the same work the reference does across pmesh C paint + pfft MPI FFT +
 the project_to_basis slab loop (SURVEY.md §3.1).
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout:
     {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
 
 ``vs_baseline`` is (estimated reference wallclock) / (ours) — >1 means
@@ -16,154 +16,374 @@ faster than the baseline. The reference publishes no absolute numbers
 nersc/example-job.slurm), documented here so the denominator is stable
 across rounds.
 
-The benchmark auto-scales down if the device cannot fit the north-star
-config (adaptive retry), reporting the achieved config in the metric
-name.
+Robustness (round-2 hardening — the round-1 bench burned its whole
+window on a wedged axon tunnel):
+- the orchestrator process NEVER imports jax; every probe/measurement
+  runs in a subprocess with a hard timeout, so a wedged backend init
+  cannot consume the window;
+- a cheap backend health probe gates everything; if it fails we print a
+  JSON line immediately (value -1) instead of timing out silently;
+- configs run smallest-first so SOME number always exists, escalating
+  to the north-star config; the largest successful config is reported;
+- a paint-only microbenchmark is recorded to stderr and
+  BENCH_DETAIL.json for kernel-level tracking.
+
+Subcommands (internal):
+    bench.py --probe                 backend sanity check
+    bench.py --config N NPART [m]    one fftpower config, JSON on stdout
+    bench.py --paint N NPART         paint-only microbench
+    bench.py --autotune N NPART      pick paint kernel ('sort'|'scatter')
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
+TOTAL_BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', 1500))
+PROBE_TIMEOUT_S = float(os.environ.get('BENCH_PROBE_TIMEOUT_S', 150))
 NOMINAL_BASELINE_S = 30.0  # see module docstring
 
 
-def autotune_paint(Nmesh=256, Npart=2_000_000):
-    """Pick the faster local paint kernel ('scatter' vs 'sort') on this
-    backend — TPU scatter-add serializes on collisions, while the sort
-    path costs a big lax.sort; which wins is hardware-dependent."""
-    import time as _t
+def _setup_jax():
+    """Import jax safely under axon: honor an explicit cpu request the
+    way __graft_entry__.py does (the sitecustomize overrides
+    JAX_PLATFORMS/XLA_FLAGS env vars, so re-assert via jax.config)."""
+    import re
+    import jax
+    if 'cpu' in os.environ.get('JAX_PLATFORMS', ''):
+        jax.config.update('jax_platforms', 'cpu')
+        m = re.search(r'xla_force_host_platform_device_count=(\d+)',
+                      os.environ.get('XLA_FLAGS', ''))
+        n = int(m.group(1)) if m else int(
+            os.environ.get('JAX_NUM_CPU_DEVICES', '0') or 0)
+        if n > 1:
+            jax.config.update('jax_num_cpu_devices', n)
+    return jax
+
+
+def cmd_probe():
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    d = jax.devices()
+    x = jnp.ones((128, 128))
+    s = float((x @ x).sum())
+    assert s == 128.0 * 128 * 128
+    print(json.dumps({"platform": d[0].platform,
+                      "kind": getattr(d[0], 'device_kind', '?'),
+                      "n": len(d)}))
+    return 0
+
+
+def _bench_fftpower_fn(pm, Npart, resampler='cic', slab_chunks=16):
+    """The fused pipeline with slab-chunked (k,mu) binning.
+
+    Binning loops over chunks of the complex field's leading axis with a
+    fori_loop so no full-mesh f32 temporaries (k2/mu/digitize indices)
+    are ever live at once — at Nmesh=1024 the unchunked version needs
+    ~6 extra 2.1 GB buffers, which does not fit v5e HBM alongside the
+    FFT workspace.
+    """
+    import numpy as np
     import jax
     import jax.numpy as jnp
-    import nbodykit_tpu
-    from nbodykit_tpu.pmesh import ParticleMesh
-
-    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
-    pos = jax.random.uniform(jax.random.key(1), (Npart, 3),
-                             jnp.float32, 0.0, 1000.0)
-    jax.block_until_ready(pos)
-    times = {}
-    for method in ['sort', 'scatter']:
-        try:
-            with nbodykit_tpu.set_options(paint_method=method):
-                f = jax.jit(lambda p: pm.paint(p, 1.0,
-                                               resampler='cic'))
-                jax.block_until_ready(f(pos))  # compile
-                t0 = _t.time()
-                for _ in range(2):
-                    out = f(pos)
-                jax.block_until_ready(out)
-                times[method] = (_t.time() - t0) / 2
-        except Exception as e:
-            print("paint method %s failed: %s" % (method, str(e)[:120]),
-                  file=sys.stderr)
-            times[method] = float('inf')
-    best = min(times, key=times.get)
-    print("paint autotune: %s  (%s)" % (best, {k: round(v, 4)
-          for k, v in times.items()}), file=sys.stderr)
-    return best
-
-
-def run_config(Nmesh, Npart, resampler='cic', paint_method='scatter'):
-    import jax
-    import jax.numpy as jnp
-    import nbodykit_tpu
-    from nbodykit_tpu.pmesh import ParticleMesh
     from nbodykit_tpu.ops.window import compensation_transfer
 
-    nbodykit_tpu.set_options(paint_method=paint_method)
-    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
-    pos = jax.random.uniform(jax.random.key(7), (Npart, 3), jnp.float32,
-                             0.0, 1000.0)
-    jax.block_until_ready(pos)
-
-    kedges = np.arange(0.0, np.pi * Nmesh / 1000.0 + np.pi / 500.0,
-                       2 * np.pi / 1000.0)
+    Nmesh = int(pm.Nmesh[0])
+    L = float(pm.BoxSize[0])
+    kedges = np.arange(0.0, np.pi * Nmesh / L + np.pi / (L / 2.0),
+                       2 * np.pi / L)
     Nx = len(kedges) - 1
     Nmu = 10
-    muedges = np.linspace(-1, 1, Nmu + 1)
-    x2edges = jnp.asarray(kedges.astype('f4') ** 2)
-    muedges_j = jnp.asarray(muedges.astype('f4'))
-    transfer = compensation_transfer(resampler, False)
-
-    V = 1000.0 ** 3
     nbins = (Nx + 2) * (Nmu + 2)
+    x2edges = jnp.asarray(kedges.astype('f4') ** 2)
+    muedges = jnp.asarray(np.linspace(-1, 1, Nmu + 1).astype('f4'))
+    transfer = compensation_transfer(resampler, False)
+    V = L ** 3
 
-    @jax.jit
+    N1c, N0c, nz = pm.shape_complex  # transposed complex layout
+    assert N1c % slab_chunks == 0
+    rows = N1c // slab_chunks
+
+    kx_full, ky_full, kz_full = pm.k_list(dtype=jnp.float32)
+    # ky is the leading axis of the transposed layout
+    ky_flat = ky_full.reshape(-1)
+
     def fftpower(pos):
+        n = pos.shape[0]
         field = pm.paint(pos, 1.0, resampler=resampler)
-        nbar = Npart / pm.Ntot
-        field = field / nbar
+        field = field / (n / pm.Ntot)
         c = pm.r2c(field)
         w = pm.k_list(dtype=jnp.float32, circular=True)
         c = transfer(w, c)
         p3 = (jnp.abs(c) ** 2).astype(jnp.float32) * V
         p3 = p3.at[0, 0, 0].set(0.0)
-        kx, ky, kz = pm.k_list(dtype=jnp.float32)
-        k2 = kx * kx + ky * ky + kz * kz
-        kk = jnp.sqrt(k2)
-        mu = jnp.where(kk == 0, 0.0, kz / jnp.where(kk == 0, 1.0, kk))
-        herm = pm.hermitian_weights(dtype=jnp.float32)
-        wgt = jnp.broadcast_to(herm, p3.shape).reshape(-1)
-        dig_x = jnp.digitize(k2.reshape(-1), x2edges)
-        dig_mu = jnp.digitize(jnp.broadcast_to(mu, p3.shape).reshape(-1),
-                              muedges_j)
-        multi = (dig_x * (Nmu + 2) + dig_mu).astype(jnp.int32)
-        Psum = jnp.bincount(multi, weights=p3.reshape(-1) * wgt,
-                            length=nbins)
-        Nsum = jnp.bincount(multi, weights=wgt, length=nbins)
-        return Psum, Nsum
+        herm_z = pm.hermitian_weights(dtype=jnp.float32)  # (1,1,nz)
 
-    # compile + warm
-    out = fftpower(pos)
+        def body(i, acc):
+            Psum, Nsum = acc
+            sl = jax.lax.dynamic_slice(p3, (i * rows, 0, 0),
+                                       (rows, N0c, nz))
+            ky = jax.lax.dynamic_slice(ky_flat, (i * rows,),
+                                       (rows,)).reshape(rows, 1, 1)
+            k2 = kx_full * kx_full + ky * ky + kz_full * kz_full
+            kk = jnp.sqrt(k2)
+            mu = jnp.where(kk == 0, 0.0,
+                           kz_full / jnp.where(kk == 0, 1.0, kk))
+            wgt = jnp.broadcast_to(herm_z, sl.shape).reshape(-1)
+            dig = (jnp.digitize(k2.reshape(-1), x2edges) * (Nmu + 2)
+                   + jnp.digitize(jnp.broadcast_to(mu, sl.shape)
+                                  .reshape(-1), muedges)).astype(jnp.int32)
+            Psum = Psum + jnp.bincount(dig, weights=sl.reshape(-1) * wgt,
+                                       length=nbins)
+            Nsum = Nsum + jnp.bincount(dig, weights=wgt, length=nbins)
+            return Psum, Nsum
+
+        init = (jnp.zeros(nbins, jnp.float32), jnp.zeros(nbins, jnp.float32))
+        return jax.lax.fori_loop(0, slab_chunks, body, init)
+
+    return fftpower
+
+
+def _make_pos(jax, jnp, Npart, L, seed=7):
+    pos = jax.random.uniform(jax.random.key(seed), (Npart, 3),
+                             jnp.float32, 0.0, L)
+    jax.block_until_ready(pos)
+    return pos
+
+
+def cmd_config(Nmesh, Npart, method='scatter', reps=3):
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import nbodykit_tpu
+    from nbodykit_tpu.pmesh import ParticleMesh
+
+    nbodykit_tpu.set_options(paint_method=method)
+    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
+    pos = _make_pos(jax, jnp, Npart, 1000.0)
+    fn = jax.jit(_bench_fftpower_fn(pm, Npart))
+    t0 = time.time()
+    out = fn(pos)
     jax.block_until_ready(out)
-    # steady state
-    reps = 3
+    compile_s = time.time() - t0
     t0 = time.time()
     for _ in range(reps):
-        out = fftpower(pos)
+        out = fn(pos)
     jax.block_until_ready(out)
-    return (time.time() - t0) / reps
+    dt = (time.time() - t0) / reps
+    print(json.dumps({
+        "metric": "fftpower_wallclock_nmesh%d_npart%.0e" % (Nmesh, Npart),
+        "value": round(dt, 4),
+        "unit": "s",
+        "vs_baseline": round(NOMINAL_BASELINE_S / dt, 2),
+        "compile_s": round(compile_s, 1),
+        "paint_method": method,
+    }))
+    return 0
+
+
+def cmd_paint(Nmesh, Npart, method='scatter', reps=3):
+    """Paint-only microbenchmark (the #1 perf risk, SURVEY §7)."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import nbodykit_tpu
+    from nbodykit_tpu.pmesh import ParticleMesh
+
+    nbodykit_tpu.set_options(paint_method=method)
+    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
+    pos = _make_pos(jax, jnp, Npart, 1000.0)
+    fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic'))
+    jax.block_until_ready(fn(pos))
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(pos)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / reps
+    print(json.dumps({
+        "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"
+                  % (Nmesh, Npart, method),
+        "value": round(dt, 4), "unit": "s",
+        "mpart_per_s": round(Npart / dt / 1e6, 1),
+    }))
+    return 0
+
+
+def cmd_autotune(Nmesh, Npart):
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import nbodykit_tpu
+    from nbodykit_tpu.pmesh import ParticleMesh
+
+    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
+    pos = _make_pos(jax, jnp, Npart, 1000.0)
+    times = {}
+    for method in ['sort', 'scatter']:
+        try:
+            with nbodykit_tpu.set_options(paint_method=method):
+                f = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic'))
+                jax.block_until_ready(f(pos))
+                t0 = time.time()
+                for _ in range(2):
+                    out = f(pos)
+                jax.block_until_ready(out)
+                times[method] = (time.time() - t0) / 2
+        except Exception as e:
+            print("paint method %s failed: %s" % (method, str(e)[:120]),
+                  file=sys.stderr)
+            times[method] = float('inf')
+    best = min(times, key=times.get)
+    print(json.dumps({"best": best,
+                      "times": {k: (round(v, 4) if v != float('inf')
+                                    else None)
+                                for k, v in times.items()}}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator (no jax in this process)
+
+def _run_sub(args, timeout):
+    """Run a bench.py subcommand; return parsed last-line JSON or None."""
+    cmd = [sys.executable, os.path.abspath(__file__)] + args
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print("[bench] %s TIMED OUT after %.0fs" % (args, timeout),
+              file=sys.stderr)
+        return None
+    dt = time.time() - t0
+    if r.stderr.strip():
+        tail = r.stderr.strip().splitlines()[-8:]
+        print("[bench] %s stderr tail: %s" % (args[0], " | ".join(tail)),
+              file=sys.stderr)
+    if r.returncode != 0:
+        print("[bench] %s rc=%d (%.0fs)" % (args, r.returncode, dt),
+              file=sys.stderr)
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
 
 
 def main():
-    configs = [
-        (1024, 100_000_000),
-        (1024, 10_000_000),
-        (512, 10_000_000),
-        (256, 1_000_000),
-        (128, 100_000),
+    deadline = time.time() + TOTAL_BUDGET_S
+    detail = {"probe": None, "autotune": None, "paint": [], "configs": []}
+
+    def left():
+        return deadline - time.time()
+
+    probe = _run_sub(['--probe'], min(PROBE_TIMEOUT_S, left()))
+    detail['probe'] = probe
+    if probe is None:
+        print(json.dumps({"metric": "fftpower_wallclock", "value": -1,
+                          "unit": "s", "vs_baseline": 0,
+                          "error": "backend probe failed/timed out"}))
+        _dump_detail(detail)
+        return 1
+    print("[bench] backend: %s" % probe, file=sys.stderr)
+
+    tune = _run_sub(['--autotune', '256', '2000000'], min(420, left()))
+    detail['autotune'] = tune
+    method = (tune or {}).get('best', 'scatter')
+    print("[bench] paint method: %s (%s)" % (method, tune),
+          file=sys.stderr)
+
+    # paint microbench at a mid scale
+    if left() > 240:
+        p = _run_sub(['--paint', '512', '10000000', method],
+                     min(420, left()))
+        detail['paint'].append(p)
+        print("[bench] paint micro: %s" % p, file=sys.stderr)
+
+    # smallest-first ladder up to the north-star config; keep the last
+    # success. The paint kernel is re-autotuned at each Nmesh scale (a
+    # small-probe winner must not be forced on large configs — the sort
+    # kernel's memory/cost profile changes with Nmesh/Npart), and a
+    # failed config is retried once with the other kernel before
+    # stopping escalation (on axon, a huge failed compile can wedge the
+    # tunnel for everyone downstream).
+    ladder = [
+        (128, 100_000, 120),
+        (256, 1_000_000, 180),
+        (512, 10_000_000, 480),
+        (1024, 10_000_000, 700),
+        (1024, 100_000_000, 700),
     ]
-    for Nmesh, Npart in configs:
-        # autotune at the config's own scale (capped probe size): the
-        # sort kernel's memory/cost profile changes with Nmesh/Npart,
-        # so a small-probe winner must not be forced on large configs
-        try:
-            method = autotune_paint(Nmesh=Nmesh,
-                                    Npart=min(Npart, 5_000_000))
-        except Exception as e:
-            print("autotune failed (%s); using scatter" % str(e)[:120],
+    best = None
+    tuned_at = 256
+    for Nmesh, Npart, budget in ladder:
+        if left() < budget * 0.5:
+            print("[bench] skipping Nmesh=%d Npart=%d (%.0fs left)"
+                  % (Nmesh, Npart, left()), file=sys.stderr)
+            break
+        if Nmesh > tuned_at and left() > budget:
+            t = _run_sub(['--autotune', str(Nmesh),
+                          str(min(Npart, 5_000_000))],
+                         min(420, left() - budget * 0.5))
+            if t is not None:
+                method = t.get('best', method)
+                tuned_at = Nmesh
+                print("[bench] re-autotuned at Nmesh=%d: %s"
+                      % (Nmesh, t), file=sys.stderr)
+        res = _run_sub(['--config', str(Nmesh), str(Npart), method],
+                       min(budget, left()))
+        if res is None:
+            other = 'sort' if method == 'scatter' else 'scatter'
+            print("[bench] config Nmesh=%d Npart=%d failed with %s; "
+                  "retrying with %s" % (Nmesh, Npart, method, other),
                   file=sys.stderr)
-            method = 'scatter'
-        try:
-            dt = run_config(Nmesh, Npart, paint_method=method)
-            metric = "fftpower_wallclock_nmesh%d_npart%.0e" % (Nmesh, Npart)
-            print(json.dumps({
-                "metric": metric,
-                "value": round(dt, 4),
-                "unit": "s",
-                "vs_baseline": round(NOMINAL_BASELINE_S / dt, 2),
-            }))
-            return 0
-        except Exception as e:
-            print("config Nmesh=%d Npart=%d failed: %s" % (Nmesh, Npart,
-                  str(e)[:200]), file=sys.stderr)
-    print(json.dumps({"metric": "fftpower_wallclock", "value": -1,
-                      "unit": "s", "vs_baseline": 0}))
-    return 1
+            if left() > budget * 0.5:
+                res = _run_sub(['--config', str(Nmesh), str(Npart),
+                                other], min(budget, left()))
+        detail['configs'].append(res)
+        if res is None:
+            print("[bench] config Nmesh=%d Npart=%d failed; stopping "
+                  "escalation" % (Nmesh, Npart), file=sys.stderr)
+            break
+        best = res
+        print("[bench] ok: %s" % res, file=sys.stderr)
+
+    _dump_detail(detail)
+    if best is None:
+        print(json.dumps({"metric": "fftpower_wallclock", "value": -1,
+                          "unit": "s", "vs_baseline": 0,
+                          "error": "no config succeeded"}))
+        return 1
+    out = {k: best[k] for k in ("metric", "value", "unit", "vs_baseline")}
+    print(json.dumps(out))
+    return 0
+
+
+def _dump_detail(detail):
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               'BENCH_DETAIL.json'), 'w') as f:
+            json.dump(detail, f, indent=1)
+    except OSError:
+        pass
 
 
 if __name__ == '__main__':
-    sys.exit(main())
+    argv = sys.argv[1:]
+    if not argv:
+        sys.exit(main())
+    if argv[0] == '--probe':
+        sys.exit(cmd_probe())
+    if argv[0] == '--config':
+        sys.exit(cmd_config(int(argv[1]), int(argv[2]),
+                            *(argv[3:4] or ['scatter'])))
+    if argv[0] == '--paint':
+        sys.exit(cmd_paint(int(argv[1]), int(argv[2]),
+                           *(argv[3:4] or ['scatter'])))
+    if argv[0] == '--autotune':
+        sys.exit(cmd_autotune(int(argv[1]), int(argv[2])))
+    print("unknown args: %r" % (argv,), file=sys.stderr)
+    sys.exit(2)

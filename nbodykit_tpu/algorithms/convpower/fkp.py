@@ -361,12 +361,20 @@ class ConvolvedFFTPower(object):
             json.dump(self.__getstate__(), ff, cls=JSONEncoder)
 
     @classmethod
-    def load(cls, output, comm=None):
+    def load(cls, output, comm=None, format='current'):
+        """Load a saved result; ``format='pre000305'`` reads the legacy
+        layout of files written by nbodykit < 0.3.5 (reference
+        fkp.py:377-406)."""
         import json
         with open(output, 'r') as ff:
             state = json.load(ff, cls=JSONDecoder)
         self = object.__new__(cls)
-        self.__setstate__(state)
+        if format == 'current':
+            self.__setstate__(state)
+        elif format == 'pre000305':
+            self.__setstate_pre000305__(state)
+        else:
+            raise ValueError("format must be 'current' or 'pre000305'")
         return self
 
     def __getstate__(self):
@@ -378,3 +386,12 @@ class ConvolvedFFTPower(object):
         self.attrs = state['attrs']
         self.edges = state['edges']
         self.poles = BinnedStatistic.from_state(state['poles'])
+
+    def __setstate_pre000305__(self, state):
+        """Files generated before nbodykit 0.3.5 store the poles as a
+        raw structured array + flat edges (reference fkp.py:349-354)."""
+        edges = state['edges']
+        self.attrs = state['attrs']
+        self.edges = edges
+        self.poles = BinnedStatistic(['k'], [edges], state['poles'],
+                                     fields_to_sum=['modes'])

@@ -62,8 +62,14 @@ class FFTCorr(FFTBase):
         rmax = self.attrs['rmax']
         if rmax is None:
             rmax = 0.5 * y3d.pm.BoxSize.min() + dr / 2
-        redges = np.arange(rmin, rmax, dr)
-        rcoords = None
+        if dr > 0:
+            redges = np.arange(rmin, rmax, dr)
+            rcoords = None
+        else:
+            # dr=0: one bin per unique lattice separation (reference
+            # fftcorr.py:167-171)
+            redges, rcoords = _find_unique_edges(y3d.pm, rmax,
+                                                 kind='real')
 
         muedges = np.linspace(0, 1, self.attrs['Nmu'] + 1, endpoint=True)
         edges = [redges, muedges]

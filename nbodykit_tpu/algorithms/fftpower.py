@@ -222,15 +222,35 @@ def _find_unique_edges(pm, xmax, kind='complex'):
     if kind == 'complex':
         coords = pm.k_list(dtype=jnp.float64)
         x0 = 2 * np.pi / pm.BoxSize
+    elif kind == 'real':
+        # min-image separation coordinates of the correlation field
+        # (the FFTCorr dr=0 case; reference fftcorr.py:171 passing
+        # RealField.x into fftpower.py:732)
+        coords = []
+        for ax, (n, h) in enumerate(zip(pm.Nmesh, pm.cellsize)):
+            shape = [1, 1, 1]
+            shape[ax] = int(n)
+            xi = jnp.fft.fftfreq(int(n), d=1.0 / int(n)).astype(
+                jnp.float64) * float(h)
+            coords.append(xi.reshape(shape))
+        x0 = np.asarray(pm.cellsize, dtype='f8')
     else:
-        raise NotImplementedError
-    x2 = sum(c ** 2 for c in coords)
+        raise ValueError("kind must be 'complex' or 'real'")
+    x2 = sum(c ** 2 for c in coords).reshape(-1)
     binning = (x0.min() * 0.05) ** 2
-    ix2 = jnp.unique((x2.reshape(-1) / binning + 0.5).astype(jnp.int64),
-                     size=min(x2.size, 1 << 20), fill_value=-1)
-    fx = np.sqrt(np.asarray(ix2[ix2 >= 0], dtype='f8') * binning)
-    fx = np.unique(np.round(fx / (x0.min() * 1e-5)).astype(np.int64)) \
-        * (x0.min() * 1e-5)
+    # unique via integer quantization, KEEPING the original float value
+    # of each bin's first occurrence (reference find_unique_local,
+    # fftpower.py:743-749) — the centers are exact, not re-quantized
+    ix2 = (x2 / binning + 0.5).astype(jnp.int64)
+    _, idx = jnp.unique(ix2, return_index=True,
+                        size=min(x2.size, 1 << 20), fill_value=-1)
+    idx = np.asarray(idx)
+    fx2 = np.asarray(x2[jnp.asarray(idx[idx >= 0])], dtype='f8')
+    fx = np.sort(np.sqrt(fx2))
+    # dedup round-off survivors with a much finer quantum
+    iy = np.round(fx / (x0.min() * 1e-5)).astype(np.int64)
+    _, ind = np.unique(iy, return_index=True)
+    fx = fx[ind]
     fx = fx[fx < xmax]
     width = np.diff(fx)
     edges = fx.copy()

@@ -4,12 +4,21 @@ Replaces the Corrfunc C/AVX kernels the reference wraps
 (nbodykit/algorithms/pair_counters/corrfunc/*; SURVEY.md §2.3): weighted
 pair counts binned in r, (r, mu), (rp, pi), or theta.
 
-Built on the shared grid-hash sweep (:class:`...ops.gridhash.GridHash`,
-also powering FOF/KDDensity/3PCF): hash the *secondary* set onto cells
-of size >= rmax, and for each primary chunk sweep the neighbor cells
-with a static per-cell capacity — every distance evaluation a dense
-vectorized op, every histogram a bincount, all inside one jitted
-program. Cost is N1 * len(offsets) * K.
+Two drivers share one counting body:
+
+- :func:`paircount` — single-device: host :class:`...ops.gridhash.GridHash`
+  prep + chunked ``lax.map`` sweep;
+- :func:`paircount_dist` — device-mesh: primaries routed tight to x-slab
+  owners, secondaries routed with both-side ghost copies within rmax
+  (:func:`...parallel.domain.slab_route` — the analog of the
+  reference's ``decompose_box_data``/``decompose_survey_data``,
+  nbodykit/algorithms/pair_counters/domain.py:47-283), then a fully
+  in-graph :class:`...ops.devicehash.DeviceGridHash` sweep per device
+  inside ``shard_map``, histograms ``psum``-reduced. No device ever
+  holds the full particle set.
+
+Every distance evaluation is a dense vectorized op, every histogram a
+bincount, all inside one jitted program.
 """
 
 import numpy as np
@@ -17,12 +26,116 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.gridhash import GridHash
+from ...ops.devicehash import DeviceGridHash
+
+
+def rmax_of(mode, edges, pimax=None):
+    """Max interaction radius of a mode/edges combination (used by
+    callers to decide whether the slab-decomposed driver fits)."""
+    edges = np.asarray(edges, dtype='f8')
+    if mode == 'angular':
+        return float(2 * np.sin(0.5 * np.radians(edges[-1])))
+    if mode == 'projected':
+        return float(np.sqrt(edges[-1] ** 2 + pimax ** 2))
+    return float(edges[-1])
+
+
+def _mode_setup(pos1, pos2, box, edges, mode, Nmu, pimax, grid_origin,
+                periodic):
+    """Shared mode normalization: work coordinates (>= 0), working box,
+    squared radial edges, bin counts, max interaction radius."""
+    box = np.asarray(box, dtype='f8')
+    edges = np.asarray(edges, dtype='f8')
+    if mode == 'angular':
+        # positions are unit vectors; chord distance bins
+        redges = 2 * np.sin(0.5 * np.radians(edges))
+        work_box = np.ones(3) * 4.0  # unit sphere fits in [-2,2]
+        p1 = pos1 + 2.0
+        p2 = pos2 + 2.0
+        periodic = False
+    else:
+        redges = edges
+        work_box = box
+        p1 = pos1 - grid_origin
+        p2 = pos2 - grid_origin
+
+    if mode == '1d':
+        rmax, nb2 = redges[-1], 1
+    elif mode == '2d':
+        rmax, nb2 = redges[-1], Nmu
+    elif mode == 'projected':
+        rmax, nb2 = np.sqrt(redges[-1] ** 2 + pimax ** 2), int(pimax)
+    elif mode == 'angular':
+        rmax, nb2 = redges[-1], 1
+    else:
+        raise ValueError("unknown mode %r" % mode)
+    nb1 = len(redges) - 1
+    return p1, p2, work_box, redges, float(rmax), nb1, nb2, periodic
+
+
+def _fold_body(grid, w2_s, r2edges, mode, nb1, nb2, pimax, losj,
+               origin_j, pair_los, is_auto, p1c, w1c, live1):
+    """The per-candidate accumulation body shared by both drivers.
+
+    ``grid`` is a GridHash or DeviceGridHash; ``w2_s`` its sorted
+    secondary weights. Returns a body for ``grid.fold`` accumulating
+    (npairs, wpairs) flat histograms of length (nb1+2)*nb2.
+    """
+    nbins_flat = (nb1 + 2) * nb2
+
+    def body(carry, j, valid, dneg, r2):
+        npairs, wpairs = carry
+        d = -dneg  # primary - secondary, as the bins expect
+        # exclude exact self-pairs in autocorrelations
+        ok = live1 & valid & ((r2 > 0) if is_auto else (r2 >= 0))
+        dig_r = jnp.digitize(r2, r2edges)
+
+        if pair_los == 'midpoint' and mode in ('2d', 'projected'):
+            # observer at the (pre-shift) coordinate origin
+            mid = 0.5 * (p1c + grid.pos_s[j]) + origin_j
+            mnorm = jnp.sqrt(jnp.sum(mid * mid, axis=-1))
+            dlos = jnp.abs(jnp.sum(d * mid, axis=-1)) \
+                / jnp.where(mnorm == 0, 1.0, mnorm)
+        else:
+            dlos = jnp.abs(d[:, losj])
+
+        if mode == '2d':
+            rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
+            mu = jnp.where(r2 == 0, 0.0, dlos / rr)
+            dig_2 = jnp.clip((mu * nb2).astype(jnp.int32), 0, nb2 - 1)
+        elif mode == 'projected':
+            drp2 = r2 - dlos * dlos
+            dig_r = jnp.digitize(drp2, r2edges)
+            dig_2 = jnp.clip(dlos.astype(jnp.int32), 0, nb2 - 1)
+            ok = ok & (dlos < pimax)
+        else:
+            dig_2 = 0
+
+        idx = dig_r * nb2 + dig_2
+        # the overflow radial bin absorbs masked-out slots
+        idx = jnp.where(ok, idx, (nb1 + 1) * nb2)
+        npairs = npairs + jnp.bincount(
+            idx, weights=jnp.where(ok, 1.0, 0.0), length=nbins_flat)
+        wpairs = wpairs + jnp.bincount(
+            idx, weights=jnp.where(ok, w1c * w2_s[j], 0.0),
+            length=nbins_flat)
+        return npairs, wpairs
+
+    return body
+
+
+def _package(npairs, wpairs, nb1, nb2):
+    npairs = np.array(npairs).reshape(nb1 + 2, nb2)
+    wpairs = np.array(wpairs).reshape(nb1 + 2, nb2)
+    # keep only in-range radial bins (1..nb1)
+    return dict(npairs=npairs[1:nb1 + 1].squeeze(),
+                wnpairs=wpairs[1:nb1 + 1].squeeze())
 
 
 def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
               pimax=None, los=2, periodic=True, is_auto=False,
               chunk=4096, grid_origin=0.0, pair_los='axis'):
-    """Weighted pair counts.
+    """Weighted pair counts (single-device driver).
 
     Parameters
     ----------
@@ -53,38 +166,10 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
     pos2 = np.asarray(pos2, dtype='f8')
     w1 = np.ones(len(pos1)) if w1 is None else np.asarray(w1, 'f8')
     w2 = np.ones(len(pos2)) if w2 is None else np.asarray(w2, 'f8')
-    box = np.asarray(box, dtype='f8')
-    edges = np.asarray(edges, dtype='f8')
 
-    if mode == 'angular':
-        # positions are unit vectors; chord distance bins
-        redges = 2 * np.sin(0.5 * np.radians(edges))
-        work_box = np.ones(3) * 4.0  # unit sphere fits in [-2,2]
-        p1 = pos1 + 2.0
-        p2 = pos2 + 2.0
-        periodic = False
-    else:
-        redges = edges
-        work_box = box
-        p1 = pos1 - grid_origin
-        p2 = pos2 - grid_origin
+    p1, p2, work_box, redges, rmax, nb1, nb2, periodic = _mode_setup(
+        pos1, pos2, box, edges, mode, Nmu, pimax, grid_origin, periodic)
 
-    if mode == '1d':
-        rmax = redges[-1]
-        nb2 = 1
-    elif mode == '2d':
-        rmax = redges[-1]
-        nb2 = Nmu
-    elif mode == 'projected':
-        rmax = np.sqrt(redges[-1] ** 2 + pimax ** 2)
-        nb2 = int(pimax)
-    elif mode == 'angular':
-        rmax = redges[-1]
-        nb2 = 1
-    else:
-        raise ValueError("unknown mode %r" % mode)
-
-    nb1 = len(redges) - 1
     grid = GridHash(p2, work_box, rmax, periodic=periodic)
     w2_s = jnp.asarray(w2[grid.order])
     r2edges = jnp.asarray(redges ** 2)
@@ -96,50 +181,12 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
     def count_chunk(args):
         p1c, w1c, live1 = args  # (C, 3), (C,), (C,)
         ci1 = grid.cell_of(p1c)
-        npairs = jnp.zeros(nbins_flat, jnp.float64)
-        wpairs = jnp.zeros(nbins_flat, jnp.float64)
-
-        def body(carry, j, valid, dneg, r2):
-            npairs, wpairs = carry
-            d = -dneg  # primary - secondary, as the bins expect
-            # exclude exact self-pairs in autocorrelations
-            ok = live1 & valid & ((r2 > 0) if is_auto else (r2 >= 0))
-            dig_r = jnp.digitize(r2, r2edges)
-
-            if pair_los == 'midpoint' and mode in ('2d', 'projected'):
-                # observer at the (pre-shift) coordinate origin
-                mid = 0.5 * (p1c + grid.pos_s[j]) + origin_j
-                mnorm = jnp.sqrt(jnp.sum(mid * mid, axis=-1))
-                dlos = jnp.abs(jnp.sum(d * mid, axis=-1)) \
-                    / jnp.where(mnorm == 0, 1.0, mnorm)
-            else:
-                dlos = jnp.abs(d[:, losj])
-
-            if mode == '2d':
-                rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
-                mu = jnp.where(r2 == 0, 0.0, dlos / rr)
-                dig_2 = jnp.clip((mu * nb2).astype(jnp.int32), 0,
-                                 nb2 - 1)
-            elif mode == 'projected':
-                drp2 = r2 - dlos * dlos
-                dig_r = jnp.digitize(drp2, r2edges)
-                dig_2 = jnp.clip(dlos.astype(jnp.int32), 0, nb2 - 1)
-                ok = ok & (dlos < pimax)
-            else:
-                dig_2 = 0
-
-            idx = dig_r * nb2 + dig_2
-            # the overflow radial bin absorbs masked-out slots
-            idx = jnp.where(ok, idx, (nb1 + 1) * nb2)
-            npairs = npairs + jnp.bincount(
-                idx, weights=jnp.where(ok, 1.0, 0.0),
-                length=nbins_flat)
-            wpairs = wpairs + jnp.bincount(
-                idx, weights=jnp.where(ok, w1c * w2_s[j], 0.0),
-                length=nbins_flat)
-            return npairs, wpairs
-
-        return grid.fold(p1c, ci1, body, (npairs, wpairs))
+        body = _fold_body(grid, w2_s, r2edges, mode, nb1, nb2, pimax,
+                          losj, origin_j, pair_los, is_auto,
+                          p1c, w1c, live1)
+        init = (jnp.zeros(nbins_flat, jnp.float64),
+                jnp.zeros(nbins_flat, jnp.float64))
+        return grid.fold(p1c, ci1, body, init)
 
     N1 = len(p1)
     nchunks = max(1, (N1 + chunk - 1) // chunk)
@@ -152,10 +199,72 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
     livej = jnp.asarray(live).reshape(nchunks, chunk)
 
     counts = jax.lax.map(count_chunk, (p1j, w1j, livej))
-    npairs = np.array(counts[0].sum(axis=0)).reshape(nb1 + 2, nb2)
-    wpairs = np.array(counts[1].sum(axis=0)).reshape(nb1 + 2, nb2)
+    return _package(counts[0].sum(axis=0), counts[1].sum(axis=0),
+                    nb1, nb2)
 
-    # keep only in-range radial bins (1..nb1)
-    npairs = npairs[1:nb1 + 1]
-    wpairs = wpairs[1:nb1 + 1]
-    return dict(npairs=npairs.squeeze(), wnpairs=wpairs.squeeze())
+
+def paircount_dist(pos1, w1, pos2, w2, box, edges, mesh, mode='1d',
+                   Nmu=None, pimax=None, los=2, periodic=True,
+                   is_auto=False, grid_origin=0.0, pair_los='axis',
+                   max_ncell=4096):
+    """Weighted pair counts over the device mesh.
+
+    Same contract as :func:`paircount`, but pos/w arrive as global
+    sharded jnp arrays and the counting runs domain-decomposed: no
+    device ever gathers the catalogs. Requires rmax <= work_box_x / P
+    (single-hop ghosts); callers fall back to :func:`paircount` when
+    that fails.
+    """
+    from jax.sharding import PartitionSpec as P
+    from ...parallel.domain import slab_route
+    from ...parallel.runtime import AXIS, shard_leading
+
+    pos1 = jnp.asarray(pos1, jnp.float64)
+    pos2 = jnp.asarray(pos2, jnp.float64)
+    n1 = pos1.shape[0]
+    n2 = pos2.shape[0]
+    w1 = jnp.ones(n1, jnp.float64) if w1 is None \
+        else jnp.asarray(w1, jnp.float64)
+    w2 = jnp.ones(n2, jnp.float64) if w2 is None \
+        else jnp.asarray(w2, jnp.float64)
+
+    p1, p2, work_box, redges, rmax, nb1, nb2, periodic = _mode_setup(
+        pos1, pos2, box, edges, mode, Nmu, pimax, grid_origin, periodic)
+
+    # route primaries tight, secondaries with ghosts on both faces
+    route1, f1, live1 = slab_route(p1, work_box, None, mesh,
+                                   ghosts=None, periodic=periodic)
+    route2, f2, live2 = slab_route(p2, work_box, rmax, mesh,
+                                   ghosts='both', periodic=periodic)
+    (p1_r, w1_r), ok1, _ = route1.exchange([p1, w1])
+    (p2_r, w2_r, lv2), ok2, _ = route2.exchange(
+        [jnp.concatenate([p2] * f2), jnp.concatenate([w2] * f2), live2])
+    ok2 = ok2 & lv2
+
+    r2edges = jnp.asarray(redges ** 2)
+    losj = int(los)
+    origin_j = jnp.asarray(np.broadcast_to(
+        np.asarray(grid_origin, dtype='f8'), (3,)))
+    nbins_flat = (nb1 + 2) * nb2
+
+    def local(p1_l, w1_l, ok1_l, p2_l, w2_l, ok2_l):
+        grid = DeviceGridHash(p2_l, work_box, rmax, valid=ok2_l,
+                              periodic=periodic, max_ncell=max_ncell,
+                              axis_name=AXIS)
+        w2_s = w2_l[grid.order]
+        ci1 = grid.cell_of(p1_l)
+        body = _fold_body(grid, w2_s, r2edges, mode, nb1, nb2, pimax,
+                          losj, origin_j, pair_los, is_auto,
+                          p1_l, w1_l, ok1_l)
+        init = (jnp.zeros(nbins_flat, jnp.float64),
+                jnp.zeros(nbins_flat, jnp.float64))
+        npairs, wpairs = grid.fold(p1_l, ci1, body, init)
+        return (jax.lax.psum(npairs, AXIS),
+                jax.lax.psum(wpairs, AXIS))
+
+    npairs, wpairs = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS), P(AXIS),
+                  P(AXIS, None), P(AXIS), P(AXIS)),
+        out_specs=(P(), P())))(p1_r, w1_r, ok1, p2_r, w2_r, ok2)
+    return _package(npairs, wpairs, nb1, nb2)

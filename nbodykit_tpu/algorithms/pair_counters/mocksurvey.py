@@ -9,7 +9,8 @@ is non-periodic in a data-derived bounding box.
 import numpy as np
 
 from .base import PairCountBase, package_result
-from .core import paircount
+from .core import paircount, paircount_dist, rmax_of
+from ...parallel.runtime import mesh_size
 from ...utils import as_numpy
 from ... import transform
 
@@ -32,42 +33,59 @@ class SurveyDataPairCount(PairCountBase):
         self.attrs = dict(mode=mode, edges=np.asarray(edges), Nmu=Nmu,
                           pimax=pimax, weight=weight)
 
+        import jax.numpy as jnp
+        nproc = mesh_size(self.comm)
+        rmax = rmax_of(mode, edges, pimax)
+
         def get_pos(cat):
             if mode == 'angular':
                 pos = transform.SkyToUnitSphere(cat[ra], cat[dec])
-                return as_numpy(pos)
-            if cosmo is None:
-                raise ValueError("need a cosmology to convert redshifts "
-                                 "to distances")
-            pos = transform.SkyToCartesian(cat[ra], cat[dec],
-                                           cat[redshift], cosmo)
-            return as_numpy(pos)
+            else:
+                if cosmo is None:
+                    raise ValueError("need a cosmology to convert "
+                                     "redshifts to distances")
+                pos = transform.SkyToCartesian(cat[ra], cat[dec],
+                                               cat[redshift], cosmo)
+            return jnp.asarray(pos)
 
         pos1 = get_pos(first)
-        w1 = as_numpy(first[weight]) if weight in first else None
+        w1 = jnp.asarray(first[weight]) if weight in first else None
         if second is None or second is first:
             pos2, w2 = pos1, w1
             is_auto = True
         else:
             pos2 = get_pos(second)
-            w2 = as_numpy(second[weight]) if weight in second else None
+            w2 = jnp.asarray(second[weight]) if weight in second \
+                else None
             is_auto = False
 
         if mode == 'angular':
             box = np.ones(3)  # unused by the angular path
-            counts = paircount(pos1, w1, pos2, w2, box, edges,
-                               mode=mode, periodic=False,
-                               is_auto=is_auto)
+            kw = dict(mode=mode, periodic=False, is_auto=is_auto)
+            use_dist = nproc > 1 and rmax <= 4.0 / nproc
         else:
             # non-periodic bounding box; mu against the pair midpoint
             # direction from the observer (Corrfunc-mocks convention)
-            lo = np.minimum(pos1.min(axis=0), pos2.min(axis=0))
-            hi = np.maximum(pos1.max(axis=0), pos2.max(axis=0))
+            lo = np.minimum(np.asarray(pos1.min(axis=0)),
+                            np.asarray(pos2.min(axis=0)))
+            hi = np.maximum(np.asarray(pos1.max(axis=0)),
+                            np.asarray(pos2.max(axis=0)))
             box = (hi - lo) * 1.001 + 1e-3
-            counts = paircount(pos1, w1, pos2, w2, box, edges,
-                               mode=mode, Nmu=Nmu, pimax=pimax,
-                               periodic=False, is_auto=is_auto,
-                               grid_origin=lo, pair_los='midpoint')
+            kw = dict(mode=mode, Nmu=Nmu, pimax=pimax, periodic=False,
+                      is_auto=is_auto, grid_origin=lo,
+                      pair_los='midpoint')
+            use_dist = nproc > 1 and rmax <= box[0] / nproc
+
+        if use_dist:
+            counts = paircount_dist(pos1, w1, pos2, w2, box, edges,
+                                    self.comm, **kw)
+        else:
+            p1n = as_numpy(pos1)
+            p2n = p1n if pos2 is pos1 else as_numpy(pos2)
+            w1n = as_numpy(w1) if w1 is not None else None
+            w2n = w1n if w2 is w1 else (
+                as_numpy(w2) if w2 is not None else None)
+            counts = paircount(p1n, w1n, p2n, w2n, box, edges, **kw)
 
         W1 = float(np.sum(w1)) if w1 is not None else float(len(pos1))
         W2 = float(np.sum(w2)) if w2 is not None else float(len(pos2))

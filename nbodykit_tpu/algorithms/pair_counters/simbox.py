@@ -8,7 +8,8 @@ Corrfunc theory kernels DD/DDsmu/DDrppi). Here the grid-hash kernel of
 import numpy as np
 
 from .base import PairCountBase, package_result
-from .core import paircount
+from .core import paircount, paircount_dist, rmax_of
+from ...parallel.runtime import mesh_size
 from ...utils import as_numpy
 
 
@@ -45,25 +46,47 @@ class SimulationBoxPairCount(PairCountBase):
                           BoxSize=BoxSize, periodic=periodic, los=los,
                           Nmu=Nmu, pimax=pimax, weight=weight)
 
-        pos1 = as_numpy(first['Position'])
-        w1 = as_numpy(first[weight]) if weight in first else None
+        # device-mesh path: catalogs stay sharded, counting is domain-
+        # decomposed (reference decompose_box_data, pair_counters/
+        # domain.py:47-132); fall back to the single-device driver when
+        # rmax exceeds the slab width or there is one device
+        nproc = mesh_size(self.comm)
+        rmax = rmax_of(mode, edges, pimax)
+        workx = 4.0 if mode == 'angular' else BoxSize[0]
+        use_dist = nproc > 1 and rmax <= workx / nproc
+
+        def get(cat, col, conv):
+            if col not in cat:
+                return None
+            return conv(cat[col])
+
+        conv = (lambda x: x) if use_dist else as_numpy
+        import jax.numpy as jnp
+        aspos = (lambda x: jnp.asarray(x)) if use_dist else as_numpy
+
+        pos1 = aspos(first['Position'])
+        w1 = get(first, weight, conv)
         if second is None or second is first:
             pos2, w2 = pos1, w1
             is_auto = True
         else:
-            pos2 = as_numpy(second['Position'])
-            w2 = as_numpy(second[weight]) if weight in second else None
+            pos2 = aspos(second['Position'])
+            w2 = get(second, weight, conv)
             is_auto = False
 
-        counts = paircount(pos1, w1, pos2, w2, BoxSize, edges,
-                           mode=mode, Nmu=Nmu, pimax=pimax, los=los_i,
-                           periodic=periodic, is_auto=is_auto)
+        kw = dict(mode=mode, Nmu=Nmu, pimax=pimax, los=los_i,
+                  periodic=periodic, is_auto=is_auto)
+        if use_dist:
+            counts = paircount_dist(pos1, w1, pos2, w2, BoxSize, edges,
+                                    self.comm, **kw)
+        else:
+            counts = paircount(pos1, w1, pos2, w2, BoxSize, edges, **kw)
 
         W1 = float(np.sum(w1)) if w1 is not None else float(len(pos1))
         W2 = float(np.sum(w2)) if w2 is not None else float(len(pos2))
         if is_auto:
-            sumw2 = float(np.sum((w1 if w1 is not None
-                                  else np.ones(len(pos1))) ** 2))
+            sumw2 = float(np.sum(np.asarray(w1) ** 2)) \
+                if w1 is not None else float(len(pos1))
             total = W1 * W1 - sumw2
         else:
             total = W1 * W2

@@ -432,3 +432,146 @@ class BinnedStatistic(object):
         obj = cls.from_state(state)
         obj.attrs.update(kwargs)
         return obj
+
+    @classmethod
+    def from_plaintext(cls, dims, filename, **kwargs):
+        """Initialize from the deprecated nbodykit 0.1.x ASCII storage
+        (reference binned_statistic.py:505-551; readers :957 and
+        :1032). Kept for loading legacy measurement files."""
+        import warnings
+        warnings.warn(
+            "storage of BinnedStatistic objects as ASCII plaintext "
+            "files is deprecated; see BinnedStatistic.from_json",
+            FutureWarning, stacklevel=2)
+        if not isinstance(dims, (tuple, list)):
+            raise TypeError("`dims` should be a list or tuple of "
+                            "strings")
+        try:
+            if len(dims) == 1:
+                data, meta = _read_1d_plaintext(filename)
+            elif len(dims) == 2:
+                data, meta = _read_2d_plaintext(filename)
+            else:
+                raise ValueError("plaintext storage supports 1 or 2 "
+                                 "dimensions")
+        except Exception as e:
+            raise ValueError(
+                "unable to read plaintext file, perhaps the dimension "
+                "of the file does not match the passed `dims`;\n"
+                "exception: %s" % str(e))
+        edges = meta.pop('edges', None)
+        if edges is None:
+            raise ValueError("plaintext file does not include `edges`; "
+                             "cannot be loaded into a BinnedStatistic")
+        if len(dims) == 1:
+            edges = [edges]
+            columns = meta.pop('columns', None)
+            if columns is None:
+                raise ValueError("1D plaintext file must name its "
+                                 "columns in a leading '#' line")
+            d = {name: data[:, i] for i, name in enumerate(columns)}
+        else:
+            d = {name: data[name] for name in data.dtype.names}
+        meta.update(kwargs)
+        return cls(dims, edges, d, **meta)
+
+
+# ---------------------------------------------------------------------------
+# deprecated nbodykit 0.1.x plaintext measurement formats
+# (reference binned_statistic.py:957-1139)
+
+def _cast_meta(name, value, castname, metadata):
+    import builtins
+    if hasattr(builtins, castname):
+        metadata[name] = getattr(builtins, castname)(value)
+    elif hasattr(np, castname):
+        metadata[name] = getattr(np, castname)(value)
+    else:
+        raise TypeError("metadata must have builtin or numpy type")
+
+
+def _read_1d_plaintext(filename):
+    """1D format: '# col names' first line, data rows, then '# edges N'
+    followed by N '#<float>' lines, then optionally '# metadata N'
+    followed by N '# name value type' lines."""
+    data = []
+    metadata = {}
+    with open(filename, 'r') as ff:
+        lines = ff.readlines()
+    cur = 0
+    if lines and lines[0][0] == '#':
+        metadata['columns'] = lines[0][1:].split()
+        cur = 1
+    while cur < len(lines):
+        line = lines[cur]
+        if not line.strip():
+            cur += 1
+            continue
+        if line[0] != '#':
+            data.append([float(l) for l in line.split()])
+            cur += 1
+            continue
+        body = line[1:]
+        if 'edges' in body:
+            N = int(body.split()[-1])
+            metadata['edges'] = np.array(
+                [float(l[1:]) for l in lines[cur + 1:cur + 1 + N]])
+            cur += 1 + N
+            continue
+        if 'metadata' in body:
+            N = int(body.split()[-1])
+            for meta in lines[cur + 1:cur + 1 + N]:
+                name, value, castname = meta[1:].split()
+                _cast_meta(name, value, castname, metadata)
+            cur += 1 + N
+            continue
+        cur += 1
+    return np.asarray(data), metadata
+
+
+def _read_2d_plaintext(filename):
+    """2D format: 'Nx Ny' first line, column names second, Nx*Ny data
+    rows, then two edge blocks each headed by a line ending in its
+    length, then optional metadata rows 'name value type'."""
+    metadata = {}
+    d = {}
+    with open(filename, 'r') as ff:
+        Nx, Ny = [int(l) for l in ff.readline().split()]
+        N = Nx * Ny
+        columns = ff.readline().split()
+        lines = ff.readlines()
+    data = np.array([float(l) for line in lines[:N]
+                     for l in line.split()])
+    data = data.reshape((Nx, Ny, -1))
+    i = 0
+    while i < len(columns):
+        name = columns[i]
+        nextname = columns[i + 1] if i < len(columns) - 1 else ''
+        if name.endswith('.real') and nextname.endswith('.imag'):
+            name = name[:-len('.real')]
+            d[name] = data[..., i] + 1j * data[..., i + 1]
+            i += 2
+        else:
+            d[name] = data[..., i]
+            i += 1
+    dtypes = np.dtype([(name, d[name].dtype) for name in d])
+    out = np.empty(data.shape[:2], dtype=dtypes)
+    for name in d:
+        out[name] = d[name]
+
+    edges = []
+    l1 = int(lines[N].split()[-1])
+    N = N + 1
+    edges.append(np.array([float(line) for line in lines[N:N + l1]]))
+    l2 = int(lines[N + l1].split()[-1])
+    N = N + l1 + 1
+    edges.append(np.array([float(line) for line in lines[N:N + l2]]))
+    metadata['edges'] = edges
+
+    if len(lines) > N + l2:
+        n_meta = int(lines[N + l2].split()[-1])
+        N = N + l2 + 1
+        for line in lines[N:N + n_meta]:
+            name, value, castname = line.split()
+            _cast_meta(name, value, castname, metadata)
+    return out, metadata

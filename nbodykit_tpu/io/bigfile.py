@@ -1,21 +1,40 @@
-"""The column-store used for catalog/mesh persistence.
+"""bigfile: the column store used for catalog/mesh persistence.
 
-Reference capability: ``nbodykit/io/bigfile.py:16`` (reader) and the
-bigfile C library (SURVEY.md §2.3) used for ``CatalogSource.save``
-(base/catalog.py:562-703) and mesh save (base/mesh.py:367-412).
+Reference capability: ``nbodykit/io/bigfile.py:16`` (reader over the
+bigfile C library) used for ``CatalogSource.save`` (reference
+base/catalog.py:562-703) and mesh save (base/mesh.py:367-412). bigfile
+is the native format of FastPM / MP-Gadget snapshots, so reading and
+writing the *actual* on-disk format (not a lookalike) is what lets data
+flow between this framework and the wider simulation ecosystem.
 
-On-disk layout (plain files; self-describing; written/read in pure
-numpy — no C dependency):
+On-disk format (rainwoodman/bigfile; plain files, implemented here in
+pure numpy with no C dependency):
 
-    <root>/
-      <dataset>/            one directory per column ("block")
-        header.json         {"dtype": "<f8", "shape": [N, ...], "nfile": K}
-        000000.bin ...      raw little-endian binary chunks
-      <header>/attrs.json   dataset attributes (numpy-aware JSON)
+    <root>/                     a bigfile is a directory
+      <block>/                  a block (column) is a subdirectory
+        header                  ASCII:  DTYPE: <f8
+                                        NMEMB: 3
+                                        NFILE: 2
+                                        000000: 500 : <checksum>
+                                        000001: 500 : <checksum>
+        000000, 000001, ...     raw little-endian data, hex-named,
+                                file i holding the i-th row range
+        attr-v2                 one attribute per line:
+                                ``<name> <dtype> <nmemb> <hex bytes>
+                                #HUMANE [ <repr> ]``
 
-This is bigfile-in-spirit (block-per-column, chunked plain binary,
-plain-text header); the header encoding is JSON rather than the C
-library's text format.
+Compatibility notes:
+
+- per-file checksums are written as the 32-bit byte sum (the C
+  library's sysv-style accumulator); readers (including the C library)
+  do not verify them on load, so a checksum-convention mismatch cannot
+  break interchange;
+- attributes are parsed from the first four whitespace-separated
+  fields; everything after the hex payload (the ``#HUMANE [...]``
+  comment the C library appends) is ignored, and string values stored
+  as ``json://``-prefixed S1 arrays round-trip through
+  :class:`...utils.JSONDecoder` exactly as the reference readers do
+  (reference io/bigfile.py:84-88).
 """
 
 import json
@@ -26,9 +45,113 @@ import numpy as np
 from .base import FileType
 from ..utils import JSONEncoder, JSONDecoder
 
+_HEADER = 'header'
+_ATTRS = 'attr-v2'
+
+
+def _checksum(data):
+    """bigfile's per-physical-file checksum: 32-bit unsigned byte sum."""
+    return int(np.frombuffer(data, dtype=np.uint8)
+               .sum(dtype=np.uint64) & 0xFFFFFFFF)
+
+
+def _norm_dtype(dt):
+    """numpy dtype -> bigfile DTYPE string ('<f8' style, explicit
+    little-endian byte order for native types)."""
+    dt = np.dtype(dt)
+    s = dt.str
+    if s[0] == '=':
+        s = '<' + s[1:]
+    return s
+
+
+def _file_bounds(size, nfile):
+    return np.linspace(0, size, nfile + 1).astype('i8')
+
+
+# ------------------------------------------------------------ attributes
+
+def _attr_encode(value):
+    """Value -> (dtype_str, nmemb, raw_bytes). Strings become S1 arrays
+    (the C library convention); everything else must be numpy-castable."""
+    if isinstance(value, str):
+        raw = value.encode('utf-8')
+        return '|S1', len(raw), raw
+    arr = np.asarray(value)
+    if arr.dtype == object:
+        raise ValueError("attribute of type %r is not storable"
+                         % type(value))
+    if arr.dtype.kind in 'SU':
+        raw = arr.astype('S').tobytes()
+        return '|S1', len(raw), raw
+    if arr.dtype.byteorder == '>':
+        arr = arr.astype(arr.dtype.newbyteorder('<'))
+    return _norm_dtype(arr.dtype), int(arr.size), \
+        np.ascontiguousarray(arr).tobytes()
+
+
+def _attr_humane(value):
+    try:
+        arr = np.asarray(value)
+        if arr.dtype.kind in 'SU' or isinstance(value, str):
+            return str(value)
+        return ' '.join(str(x) for x in np.atleast_1d(arr).ravel()[:8])
+    except Exception:
+        return ''
+
+
+def write_attrs_file(bdir, attrs):
+    """Serialize an attrs dict to ``<bdir>/attr-v2``. Values that are
+    not numpy-castable are stored as ``json://`` strings (the
+    reference's convention, base/catalog.py:676-683)."""
+    lines = []
+    for name in sorted(attrs):
+        value = attrs[name]
+        try:
+            dt, nmemb, raw = _attr_encode(value)
+        except (ValueError, TypeError):
+            s = 'json://' + json.dumps(value, cls=JSONEncoder)
+            dt, nmemb, raw = _attr_encode(s)
+        lines.append('%s %s %d %s #HUMANE [ %s ]\n' % (
+            name, dt, nmemb, raw.hex().upper(),
+            _attr_humane(value)))
+    with open(os.path.join(bdir, _ATTRS), 'w') as ff:
+        ff.writelines(lines)
+
+
+def read_attrs_file(bdir, decode_json=True):
+    """Parse ``<bdir>/attr-v2``; missing file -> empty dict."""
+    fn = os.path.join(bdir, _ATTRS)
+    out = {}
+    if not os.path.exists(fn):
+        return out
+    with open(fn) as ff:
+        for line in ff:
+            parts = line.split()
+            if len(parts) < 3:
+                continue
+            name, dt, nmemb = parts[:3]
+            # zero-length payloads leave the hex field empty, so the
+            # next token (if any) is the #HUMANE comment
+            hexdata = ''
+            if len(parts) > 3 and not parts[3].startswith('#'):
+                hexdata = parts[3]
+            raw = bytes.fromhex(hexdata)
+            if np.dtype(dt).kind == 'S':
+                value = raw.decode('utf-8', errors='replace')
+                if decode_json and value.startswith('json://'):
+                    value = json.loads(value[7:], cls=JSONDecoder)
+            else:
+                arr = np.frombuffer(raw, dtype=np.dtype(dt))
+                value = arr[0] if int(nmemb) == 1 else arr.copy()
+            out[name] = value
+    return out
+
+
+# ----------------------------------------------------------------- write
 
 class BigFileWriter(object):
-    """Writer for the block column store."""
+    """Writer producing the real bigfile directory layout."""
 
     def __init__(self, path, create=True):
         self.path = path
@@ -41,50 +164,85 @@ class BigFileWriter(object):
     def __exit__(self, *args):
         pass
 
-    def write(self, dataset, array, attrs=None, nfile=1):
-        """Write one column (any-dimensional numpy array) as a block."""
+    def write(self, dataset, array, attrs=None, nfile=None):
+        """Write one column as a block. Arrays of ndim > 2 are stored
+        flattened per row (NMEMB = prod of the item shape); callers
+        persisting full meshes record the logical shape in an
+        ``ndarray.shape`` attr (the reference's convention,
+        base/mesh.py:393-397)."""
         array = np.ascontiguousarray(array)
+        if array.dtype.byteorder == '>':
+            array = array.astype(array.dtype.newbyteorder('<'))
+        size = len(array)
+        nmemb = int(np.prod(array.shape[1:], dtype=int))
+        flat = array.reshape(size, nmemb) if array.ndim > 1 else array
+        if nfile is None:
+            # the reference targets ~32M rows per physical file
+            nfile = max(1, (size + (1 << 25) - 1) >> 25)
+
         bdir = os.path.join(self.path, dataset)
         os.makedirs(bdir, exist_ok=True)
-        header = {
-            'dtype': array.dtype.str,
-            'shape': list(array.shape),
-            'nfile': nfile,
-        }
-        with open(os.path.join(bdir, 'header.json'), 'w') as ff:
-            json.dump(header, ff)
-        bounds = np.linspace(0, len(array), nfile + 1).astype(int)
+        bounds = _file_bounds(size, nfile)
+        entries = []
         for i, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:])):
-            with open(os.path.join(bdir, '%06d.bin' % i), 'wb') as ff:
-                array[lo:hi].tofile(ff)
+            raw = flat[lo:hi].tobytes()
+            with open(os.path.join(bdir, '%06X' % i), 'wb') as ff:
+                ff.write(raw)
+            entries.append((i, hi - lo, _checksum(raw)))
+        with open(os.path.join(bdir, _HEADER), 'w') as ff:
+            ff.write('DTYPE: %s\n' % _norm_dtype(array.dtype))
+            ff.write('NMEMB: %d\n' % nmemb)
+            ff.write('NFILE: %d\n' % nfile)
+            for i, n, cks in entries:
+                ff.write('%06X: %d : %d\n' % (i, n, cks))
         if attrs:
             self.write_attrs(dataset, attrs, merge=True)
 
     def write_attrs(self, dataset, attrs, merge=False):
+        """Write (or merge into) a block's attribute set; creates a
+        zero-sized block if the dataset does not exist yet (bigfile
+        header blocks are normally empty blocks carrying attrs)."""
         bdir = os.path.join(self.path, dataset)
-        os.makedirs(bdir, exist_ok=True)
-        fn = os.path.join(bdir, 'attrs.json')
+        if not os.path.exists(os.path.join(bdir, _HEADER)):
+            self.write(dataset, np.empty(0, dtype='i8'), nfile=0)
         out = {}
-        if merge and os.path.exists(fn):
-            with open(fn) as ff:
-                out = json.load(ff, cls=JSONDecoder)
+        if merge:
+            out = read_attrs_file(bdir, decode_json=False)
         out.update(attrs)
-        with open(fn, 'w') as ff:
-            json.dump(out, ff, cls=JSONEncoder)
+        write_attrs_file(bdir, out)
 
+
+# ------------------------------------------------------------------ read
 
 class BigFileDataset(object):
     """A single on-disk block (column)."""
 
     def __init__(self, root, name):
         self.dir = os.path.join(root, name)
-        with open(os.path.join(self.dir, 'header.json')) as ff:
-            h = json.load(ff)
-        self.dtype = np.dtype(h['dtype'])
-        self.shape = tuple(h['shape'])
-        self.nfile = h['nfile']
-        n = self.shape[0] if self.shape else 0
-        self.bounds = np.linspace(0, n, self.nfile + 1).astype(int)
+        fn = os.path.join(self.dir, _HEADER)
+        fields = {}
+        entries = []
+        with open(fn) as ff:
+            for line in ff:
+                if ':' not in line:
+                    continue
+                key, _, rest = line.partition(':')
+                key = key.strip()
+                if key in ('DTYPE', 'NMEMB', 'NFILE'):
+                    fields[key] = rest.strip()
+                else:
+                    entries.append((int(key, 16),
+                                    int(rest.split(':')[0])))
+        self.dtype = np.dtype(fields['DTYPE'])
+        self.nmemb = int(fields.get('NMEMB', 1))
+        self.nfile = int(fields.get('NFILE', 0))
+        sizes = np.zeros(self.nfile, dtype='i8')
+        for i, n in entries:
+            sizes[i] = n
+        self.bounds = np.concatenate([[0], np.cumsum(sizes)])
+        n = int(self.bounds[-1])
+        self.shape = (n,) if self.nmemb == 1 else (n, self.nmemb)
+        self.attrs = read_attrs_file(self.dir)
 
     @property
     def size(self):
@@ -92,7 +250,7 @@ class BigFileDataset(object):
 
     def read(self, start, stop):
         itemshape = self.shape[1:]
-        nper = int(np.prod(itemshape, dtype=int))
+        nper = self.nmemb
         out = np.empty((stop - start,) + itemshape, dtype=self.dtype)
         for i in range(self.nfile):
             lo, hi = self.bounds[i], self.bounds[i + 1]
@@ -100,7 +258,7 @@ class BigFileDataset(object):
             e = min(stop, hi)
             if s >= e:
                 continue
-            fn = os.path.join(self.dir, '%06d.bin' % i)
+            fn = os.path.join(self.dir, '%06X' % i)
             with open(fn, 'rb') as ff:
                 ff.seek((s - lo) * self.dtype.itemsize * nper)
                 data = np.fromfile(ff, dtype=self.dtype,
@@ -109,10 +267,15 @@ class BigFileDataset(object):
         return out
 
 
+def _is_block(bdir):
+    return os.path.isdir(bdir) and \
+        os.path.exists(os.path.join(bdir, _HEADER))
+
+
 class BigFile(FileType):
-    """Reader exposing the FileType contract over a block store
-    (reference: nbodykit/io/bigfile.py:16 with ``dataset`` and
-    ``exclude`` semantics)."""
+    """Reader exposing the FileType contract over a bigfile directory
+    (reference: nbodykit/io/bigfile.py:16 with ``dataset``, ``header``
+    and ``exclude`` semantics)."""
 
     def __init__(self, path, exclude=None, header='Header', dataset='./'):
         self.path = path
@@ -122,21 +285,18 @@ class BigFile(FileType):
         self.root = root
 
         if exclude is None:
-            exclude = [header, 'Header', 'attrs.json']
-        blocks = []
+            exclude = [header, 'Header']
+        self._blocks = {}
         for name in sorted(os.listdir(root)):
             bdir = os.path.join(root, name)
-            if not os.path.isdir(bdir):
+            if not _is_block(bdir) or name in exclude:
                 continue
-            if name in exclude:
-                continue
-            if os.path.exists(os.path.join(bdir, 'header.json')):
-                blocks.append(name)
+            b = BigFileDataset(root, name)
+            if b.size:
+                self._blocks[name] = b
+        blocks = list(self._blocks)
         if not blocks:
             raise ValueError("no data blocks found under %s" % root)
-
-        self._blocks = {name: BigFileDataset(root, name)
-                        for name in blocks}
         sizes = {name: b.size for name, b in self._blocks.items()}
         if len(set(sizes.values())) > 1:
             raise ValueError("column size mismatch: %s" % sizes)
@@ -150,13 +310,13 @@ class BigFile(FileType):
                       else (name, b.dtype))
         self.dtype = np.dtype(dt)
 
-        # attrs from the header dataset
+        # attrs from the header block (searched relative to the file
+        # root, like the reference)
         self.attrs = {}
         for hdr in [header, 'Header']:
-            fn = os.path.join(root, hdr, 'attrs.json')
-            if os.path.exists(fn):
-                with open(fn) as ff:
-                    self.attrs = json.load(ff, cls=JSONDecoder)
+            bdir = os.path.join(path, hdr)
+            if os.path.isdir(bdir):
+                self.attrs = read_attrs_file(bdir)
                 break
 
     def read(self, columns, start, stop, step=1):

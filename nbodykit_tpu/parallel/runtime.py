@@ -112,8 +112,17 @@ def sharding(mesh, *spec):
 
 
 def shard_leading(mesh, arr):
-    """Place a global array so its leading axis is sharded over the mesh."""
+    """Place a global array so its leading axis is sharded over the mesh.
+
+    Ragged sizes (leading axis not divisible by the mesh) are returned
+    unsharded — the catalog-column convention: such arrays get
+    distributed by the next exchange, which pads internally
+    (base/catalog.py __setitem__, parallel/exchange.py).
+    """
     if mesh is None:
+        return arr
+    n = mesh.shape[AXIS]
+    if arr.shape[0] % n:
         return arr
     spec = (AXIS,) + (None,) * (arr.ndim - 1)
     return jax.device_put(arr, NamedSharding(mesh, P(*spec)))

@@ -22,6 +22,8 @@ Everything returned is a plain jnp array; the RealField/ComplexField
 wrappers in :mod:`nbodykit_tpu.base.mesh` add attrs/convenience methods.
 """
 
+import logging
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -53,6 +55,8 @@ class ParticleMesh(object):
     comm : jax.sharding.Mesh or None — the device mesh (defaults to the
         ambient :class:`~nbodykit_tpu.parallel.runtime.CurrentMesh`)
     """
+
+    logger = logging.getLogger('ParticleMesh')
 
     def __init__(self, Nmesh, BoxSize, dtype='f4', comm=None):
         self.Nmesh = _triplet(Nmesh, 'i8')
@@ -205,7 +209,7 @@ class ParticleMesh(object):
         return n0
 
     def paint(self, pos, mass=1.0, resampler=None, out=None, shift=0.0,
-              capacity=None):
+              capacity=None, return_dropped=False):
         """Scatter particles onto the mesh; returns a real field.
 
         Parameters
@@ -217,6 +221,16 @@ class ParticleMesh(object):
             (used by interlacing, reference source/mesh/catalog.py:292)
         capacity : per-(src,dst) exchange capacity; default derived from
             particle count and the 'exchange_slack' option.
+        return_dropped : also return the exchange-overflow count so
+            traced callers can check it after the step.
+
+        Overflow contract (reference analog: the paint chunk backoff
+        loop, nbodykit/source/mesh/catalog.py:275-315): with the default
+        capacity, overflow is impossible (exact bound eagerly, ceil
+        bound under trace). An explicit ``capacity`` is retried eagerly
+        with doubled capacity until nothing drops; under a trace the
+        check cannot branch, so ``return_dropped=True`` is REQUIRED —
+        silent particle loss is never possible.
         """
         resampler = resampler or _global_options['resampler']
         h = window_support(resampler)
@@ -235,17 +249,16 @@ class ParticleMesh(object):
                            resampler=resampler, period=self.shape_real,
                            origin=0)
             out = block if out is None else out + block
+            if return_dropped:
+                return out, jnp.zeros((), jnp.int32)
             return out
 
         n0 = self._check_halo(h)
         # route particles (in cell units) to their slab owner
         cell = jnp.mod(jnp.floor(cpos[:, 0]).astype(jnp.int32), N0)
         dest = cell // n0
-        recv, valid, dropped = exchange_by_dest(
-            dest, [cpos, massa], self.comm, capacity)
-        cpos_r, mass_r = recv
-        mass_r = jnp.where(valid, mass_r, 0.0).astype(self.dtype)
-
+        traced = isinstance(cpos, jax.core.Tracer)
+        self._check_overflow_contract(capacity, traced, return_dropped)
         nproc = self.nproc
 
         def local(cpos_l, mass_l):
@@ -256,17 +269,60 @@ class ParticleMesh(object):
                          origin=origin)
             return halo_add(ext, h, nproc)
 
-        block = jax.shard_map(
-            local, mesh=self.comm,
-            in_specs=(P(AXIS, None), P(AXIS)),
-            out_specs=P(AXIS, None, None))(cpos_r, mass_r)
+        def attempt(cap):
+            recv, valid, dropped = exchange_by_dest(
+                dest, [cpos, massa], self.comm, cap)
+            cpos_r, mass_r = recv
+            mass_r = jnp.where(valid, mass_r, 0.0).astype(self.dtype)
+            block = jax.shard_map(
+                local, mesh=self.comm,
+                in_specs=(P(AXIS, None), P(AXIS)),
+                out_specs=P(AXIS, None, None))(cpos_r, mass_r)
+            return block, dropped
+
+        block, dropped = attempt(capacity)
+        if not traced and capacity is not None:
+            block, dropped, capacity = self._retry_grown(
+                attempt, block, dropped, capacity, npart)
         out = block if out is None else out + block
+        if return_dropped:
+            return out, dropped
         return out
 
-    def readout(self, real, pos, resampler=None, capacity=None):
+    def _check_overflow_contract(self, capacity, traced, return_dropped):
+        if traced and capacity is not None and not return_dropped:
+            raise ValueError(
+                "paint/readout with an explicit capacity inside jit "
+                "cannot retry on exchange overflow; pass "
+                "return_dropped=True and check the count after the "
+                "step (or use the default capacity, which cannot "
+                "overflow)")
+
+    def _retry_grown(self, attempt, block, dropped, capacity, npart):
+        """Eager backoff: double the exchange capacity until no
+        particle drops (reference: source/mesh/catalog.py:275-315)."""
+        cap_max = -(-npart // self.nproc) + 8
+        while int(dropped) > 0 and capacity < cap_max:
+            capacity = min(2 * capacity, cap_max)
+            self.logger.info(
+                "exchange overflow (%d dropped); retrying with "
+                "capacity=%d" % (int(dropped), capacity))
+            block, dropped = attempt(capacity)
+        if int(dropped) > 0:
+            raise RuntimeError(
+                "particle exchange still overflowing at the maximal "
+                "capacity %d — this should be impossible" % capacity)
+        return block, dropped, capacity
+
+    def readout(self, real, pos, resampler=None, capacity=None,
+                return_dropped=False):
         """Interpolate a real field at particle positions (inverse of
         paint; reference: pmesh Field.readout, used by FFTRecon at
-        algorithms/fftrecon.py:217-268)."""
+        algorithms/fftrecon.py:217-268).
+
+        ``capacity``/``return_dropped`` follow the same overflow
+        contract as :meth:`paint`.
+        """
         resampler = resampler or _global_options['resampler']
         h = window_support(resampler)
         N0, N1, N2 = self.shape_real
@@ -274,16 +330,18 @@ class ParticleMesh(object):
         npart = pos.shape[0]
 
         if self.nproc == 1:
-            return readout_local(real, cpos, resampler=resampler,
-                                 period=self.shape_real, origin=0)
+            out = readout_local(real, cpos, resampler=resampler,
+                                period=self.shape_real, origin=0)
+            if return_dropped:
+                return out, jnp.zeros((), jnp.int32)
+            return out
 
         n0 = self._check_halo(h)
         cell = jnp.mod(jnp.floor(cpos[:, 0]).astype(jnp.int32), N0)
         dest = cell // n0
         gidx = jnp.arange(npart, dtype=jnp.int32)
-        recv, valid, dropped = exchange_by_dest(
-            dest, [cpos, gidx], self.comm, capacity)
-        cpos_r, gidx_r = recv
+        traced = isinstance(cpos, jax.core.Tracer)
+        self._check_overflow_contract(capacity, traced, return_dropped)
         nproc = self.nproc
 
         def local(real_l, cpos_l):
@@ -293,15 +351,29 @@ class ParticleMesh(object):
             return readout_local(ext, cpos_l, resampler=resampler,
                                  period=(N0, N1, N2), origin=origin)
 
-        vals = jax.shard_map(
-            local, mesh=self.comm,
-            in_specs=(P(AXIS, None, None), P(AXIS, None)),
-            out_specs=P(AXIS))(real, cpos_r)
-        # return to original particle order: masked scatter by global index
-        vals = jnp.where(valid, vals, 0.0)
-        gidx_r = jnp.where(valid, gidx_r, npart)
-        out = jnp.zeros((npart + 1,), vals.dtype).at[gidx_r].add(vals)
-        return out[:npart]
+        def attempt(cap):
+            recv, valid, dropped = exchange_by_dest(
+                dest, [cpos, gidx], self.comm, cap)
+            cpos_r, gidx_r = recv
+            vals = jax.shard_map(
+                local, mesh=self.comm,
+                in_specs=(P(AXIS, None, None), P(AXIS, None)),
+                out_specs=P(AXIS))(real, cpos_r)
+            # back to original particle order: masked scatter by
+            # global index
+            vals = jnp.where(valid, vals, 0.0)
+            gidx_r = jnp.where(valid, gidx_r, npart)
+            out = jnp.zeros((npart + 1,), vals.dtype).at[gidx_r].add(
+                vals)
+            return out[:npart], dropped
+
+        out, dropped = attempt(capacity)
+        if not traced and capacity is not None:
+            out, dropped, capacity = self._retry_grown(
+                attempt, out, dropped, capacity, npart)
+        if return_dropped:
+            return out, dropped
+        return out
 
     # -- white noise ------------------------------------------------------
 

@@ -9,11 +9,10 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...base.mesh import MeshSource, Field
-from ...io.bigfile import BigFileDataset
-from ...utils import JSONDecoder
+from ...io.bigfile import BigFileDataset, read_attrs_file
+
 from ...parallel.runtime import shard_leading, mesh_size
 
-import json
 import os
 
 
@@ -23,11 +22,7 @@ class BigFileMesh(MeshSource):
     def __init__(self, path, dataset='Field', comm=None):
         self.path = path
         self.dataset = dataset
-        fn = os.path.join(path, dataset, 'attrs.json')
-        attrs = {}
-        if os.path.exists(fn):
-            with open(fn) as ff:
-                attrs = json.load(ff, cls=JSONDecoder)
+        attrs = read_attrs_file(os.path.join(path, dataset))
         if 'ndarray.shape' not in attrs:
             raise ValueError("%s does not look like a saved mesh "
                              "(missing ndarray.shape)" % path)

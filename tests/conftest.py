@@ -11,6 +11,8 @@ initialize backends; jax.config.update still works and is the reliable
 way to get 8 CPU devices + CPU default + x64.
 """
 
+import os
+
 import jax
 import numpy as np  # noqa: F401
 import pytest
@@ -21,8 +23,108 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compile cache: the suite is compile-dominated on this
+# 1-core box (~45 min cold); cached re-runs skip nearly all of it.
+_cache_dir = os.environ.get(
+    'JAX_COMPILATION_CACHE_DIR',
+    os.path.join(os.path.dirname(__file__), '..', '.jax_cache'))
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 assert len(jax.devices("cpu")) == 8, \
     "multi-device test setup failed: expected 8 CPU devices"
+
+
+# ---------------------------------------------------------------------------
+# fast/slow tiers. The box running CI has ONE core simulating 8 devices,
+# so the suite is wall-clock dominated by shard_map programs. Tests
+# measured >= ~2.5 s (see docs/COMPONENTS.md "test tiers") are marked
+# slow centrally here; `pytest -m "not slow"` is the fast tier.
+_SLOW = {
+    "test_convpower.py::test_convpower_periodic_consistency",
+    "test_coverage_extras.py::test_fftpower_dk_zero_unique_edges",
+    "test_coverage_extras.py::test_paint_sort_method_end_to_end",
+    "test_coverage_extras.py::test_readout_device_count_invariance",
+    "test_dist_sort.py::test_catalog_sort_multi_device",
+    "test_dist_sort.py::test_dist_sort_fast_path_engages",
+    "test_dist_sort.py::test_dist_sort_floats",
+    "test_dist_sort.py::test_dist_sort_matches_numpy[10001]",
+    "test_dist_sort.py::test_dist_sort_matches_numpy[1000]",
+    "test_dist_sort.py::test_dist_sort_matches_numpy[4096]",
+    "test_dist_sort.py::test_dist_sort_skewed_fallback",
+    "test_extras.py::test_demo_halo_catalog_and_populate",
+    "test_fftpower.py::test_fftcorr_runs_and_integrates[multi]",
+    "test_fftpower.py::test_fftpower_cross[multi]",
+    "test_fftpower.py::test_fftpower_shotnoise_flat[multi]",
+    "test_fftpower.py::test_fftpower_shotnoise_flat[single]",
+    "test_fftpower.py::test_linear_mesh_recovers_power[multi]",
+    "test_fof.py::test_fof_com_periodic",
+    "test_fof.py::test_fof_features_and_com",
+    "test_fof.py::test_fof_matches_brute_force",
+    "test_fof.py::test_fof_mean_separation_units",
+    "test_fof.py::test_fof_periodic_wrap",
+    "test_fof.py::test_fof_to_halos",
+    "test_fof.py::test_fof_two_well_separated_clusters",
+    "test_groups.py::test_fibercollisions_isolated",
+    "test_groups.py::test_fibercollisions_pair",
+    "test_groups.py::test_fibercollisions_triplet_chain",
+    "test_io.py::test_mesh_save_and_bigfile_mesh",
+    "test_lognormal.py::test_lognormal_columns",
+    "test_lognormal.py::test_lognormal_device_count_invariance",
+    "test_lognormal.py::test_lognormal_power_recovery",
+    "test_lognormal.py::test_unitary_amplitude_reduces_variance",
+    "test_mesh_base.py::test_catalog_mesh_selection_column",
+    "test_mesh_base.py::test_interlacing_preserves_low_k",
+    "test_mesh_base.py::test_mesh_resample_down",
+    "test_mesh_base.py::test_value_column_weighting",
+    "test_misc_algorithms.py::test_3pcf_brute_force[0]",
+    "test_misc_algorithms.py::test_3pcf_brute_force[1]",
+    "test_misc_algorithms.py::test_3pcf_brute_force[2]",
+    "test_misc_algorithms.py::test_3pcf_nonperiodic_no_double_count",
+    "test_misc_algorithms.py::test_fftrecon_reduces_displacement",
+    "test_misc_algorithms.py::test_fof_nonperiodic",
+    "test_misc_algorithms.py::test_fof_peak_columns",
+    "test_misc_algorithms.py::test_hod_populate",
+    "test_misc_algorithms.py::test_hod_reproducible",
+    "test_paircount.py::test_2pcf_clustered_signal",
+    "test_paircount.py::test_2pcf_landy_szalay_matches_natural",
+    "test_paircount.py::test_2pcf_natural_uniform_is_zero",
+    "test_paircount.py::test_2pcf_projected_wp",
+    "test_paircount.py::test_paircount_1d_brute_force",
+    "test_paircount.py::test_paircount_2d_mu_bins",
+    "test_paircount.py::test_paircount_cross",
+    "test_paircount.py::test_paircount_projected",
+    "test_paircount.py::test_survey_2pcf_runs",
+    "test_paircount.py::test_survey_paircount_angular",
+    "test_paircount.py::test_wedges_to_poles",
+    "test_pmesh.py::test_dist_irfftn_roundtrip",
+    "test_pmesh.py::test_paint_clustered_no_mass_loss",
+    "test_pmesh.py::test_paint_device_count_invariance[cic]",
+    "test_pmesh.py::test_paint_device_count_invariance[tsc]",
+    "test_pmesh.py::test_paint_mass_conservation[multi-cic]",
+    "test_pmesh.py::test_paint_mass_conservation[multi-nnb]",
+    "test_pmesh.py::test_paint_mass_conservation[multi-pcs]",
+    "test_pmesh.py::test_paint_mass_conservation[multi-tsc]",
+    "test_pmesh.py::test_paint_nnb_is_histogram[multi]",
+    "test_pmesh.py::test_paint_non_divisible_N[multi]",
+    "test_pmesh.py::test_paint_non_divisible_N[single]",
+    "test_pmesh.py::test_readout_constant_field[multi]",
+    "test_pmesh.py::test_readout_constant_field[single]",
+    "test_pmesh.py::test_readout_linear_gradient[multi]",
+    "test_pmesh.py::test_readout_linear_gradient[single]",
+    "test_pmesh.py::test_uniform_particle_grid[multi]",
+    "test_pmesh.py::test_uniform_particle_grid[single]",
+    "test_pmesh.py::test_whitenoise_unitary",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        key = "::".join(item.nodeid.split("/")[-1].split("::")[-2:])
+        if key in _SLOW:
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture(scope='session')

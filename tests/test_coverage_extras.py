@@ -76,3 +76,112 @@ def test_paint_sort_method_end_to_end():
     np.testing.assert_allclose(r1.power['power'].real,
                                r2.power['power'].real, rtol=1e-5,
                                equal_nan=True)
+
+
+def test_fftcorr_dr_zero_unique_edges(comm):
+    """dr=0: one bin per unique lattice separation (reference
+    fftcorr.py:167-171 + fftpower.py:732-769)."""
+    from nbodykit_tpu.source.catalog.uniform import UniformCatalog
+    from nbodykit_tpu.algorithms.fftcorr import FFTCorr
+    from nbodykit_tpu.parallel.runtime import use_mesh
+    with use_mesh(comm):
+        cat = UniformCatalog(nbar=2e-3, BoxSize=40.0, seed=42)
+        r = FFTCorr(cat, mode='1d', Nmesh=16, dr=0, rmax=9.0)
+    rcen = r.corr.coords['r']
+    # true centers are unique |r| values on the 16^3 lattice (cell 2.5)
+    seps = np.fft.fftfreq(16, d=1.0 / 16) * 2.5
+    r2 = (seps[:, None, None] ** 2 + seps[None, :, None] ** 2
+          + seps[None, None, :] ** 2).ravel()
+    want = np.unique(np.round(np.sqrt(r2), 6))
+    want = want[want < 9.0]
+    np.testing.assert_allclose(np.sort(rcen), want, atol=1e-5)
+    # every lattice mode lands in a bin: modes sum to Nmesh^3 over all
+    # unique bins (each |r| is exact, no empty bins)
+    assert (r.corr['modes'] > 0).all()
+
+
+def test_binned_statistic_from_plaintext_1d(tmp_path):
+    from nbodykit_tpu.binned_statistic import BinnedStatistic
+    fn = str(tmp_path / 'meas1d.txt')
+    with open(fn, 'w') as f:
+        f.write("# k power modes\n")
+        for i in range(4):
+            f.write("%g %g %g\n" % (0.1 * (i + 0.5), 100.0 / (i + 1),
+                                    10 * (i + 1)))
+        f.write("# edges 5\n")
+        for e in np.linspace(0, 0.4, 5):
+            f.write("#%g\n" % e)
+        f.write("# metadata 2\n")
+        f.write("#BoxSize 100.0 float64\n")
+        f.write("#N 512 int\n")
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", FutureWarning)
+        ds = BinnedStatistic.from_plaintext(['k'], fn)
+    assert ds.shape == (4,)
+    np.testing.assert_allclose(ds['power'],
+                               [100.0, 50.0, 100 / 3.0, 25.0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(ds.edges['k'], np.linspace(0, 0.4, 5))
+    assert ds.attrs['BoxSize'] == 100.0
+    assert ds.attrs['N'] == 512
+
+
+def test_binned_statistic_from_plaintext_2d(tmp_path):
+    from nbodykit_tpu.binned_statistic import BinnedStatistic
+    fn = str(tmp_path / 'meas2d.txt')
+    Nk, Nmu = 3, 2
+    kedges = np.linspace(0, 0.3, Nk + 1)
+    muedges = np.linspace(0, 1, Nmu + 1)
+    with open(fn, 'w') as f:
+        f.write("%d %d\n" % (Nk, Nmu))
+        f.write("k mu power.real power.imag modes\n")
+        v = 0
+        for i in range(Nk):
+            for j in range(Nmu):
+                v += 1
+                f.write("%g %g %g %g %d\n"
+                        % (0.1 * (i + .5), 0.5 * (j + .5), 10.0 * v,
+                           -1.0 * v, v))
+        f.write("edges %d\n" % (Nk + 1))
+        for e in kedges:
+            f.write("%g\n" % e)
+        f.write("edges %d\n" % (Nmu + 1))
+        for e in muedges:
+            f.write("%g\n" % e)
+        f.write("metadata 1\n")
+        f.write("volume 1000.0 float64\n")
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", FutureWarning)
+        ds = BinnedStatistic.from_plaintext(['k', 'mu'], fn)
+    assert ds.shape == (3, 2)
+    assert np.iscomplexobj(ds['power'])
+    np.testing.assert_allclose(ds['power'].real,
+                               10.0 * np.arange(1, 7).reshape(3, 2))
+    np.testing.assert_allclose(ds['power'].imag,
+                               -np.arange(1, 7).reshape(3, 2))
+    np.testing.assert_allclose(ds.edges['mu'], muedges)
+    assert ds.attrs['volume'] == 1000.0
+
+
+def test_convpower_legacy_load(tmp_path):
+    """pre-0.3.5 ConvolvedFFTPower files load via format='pre000305'
+    (reference convpower/fkp.py:349-354,377-406)."""
+    import json
+    from nbodykit_tpu.algorithms.convpower.fkp import ConvolvedFFTPower
+    from nbodykit_tpu.utils import JSONEncoder
+    kedges = np.linspace(0, 0.3, 4)
+    poles = np.empty(3, dtype=[('k', 'f8'), ('power_0', 'c16'),
+                               ('modes', 'i8')])
+    poles['k'] = 0.5 * (kedges[1:] + kedges[:-1])
+    poles['power_0'] = [100 + 0j, 50 + 0j, 25 + 0j]
+    poles['modes'] = [10, 20, 30]
+    state = dict(edges=kedges, poles=poles,
+                 attrs={'poles': [0], 'shotnoise': 12.0})
+    fn = str(tmp_path / 'legacy.json')
+    with open(fn, 'w') as f:
+        json.dump(state, f, cls=JSONEncoder)
+    r = ConvolvedFFTPower.load(fn, format='pre000305')
+    np.testing.assert_allclose(r.poles['power_0'].real, [100, 50, 25])
+    assert r.attrs['shotnoise'] == 12.0

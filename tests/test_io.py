@@ -173,3 +173,91 @@ def test_file_stack(tmp_path, data):
     out = stack.read(['Mass'], 100, 300)
     want = np.concatenate([data['Mass'] + i for i in range(3)])[100:300]
     np.testing.assert_array_equal(out['Mass'], want)
+
+
+def test_bigfile_on_disk_format(tmp_path):
+    """Pin the real bigfile layout (rainwoodman/bigfile): ASCII block
+    header with DTYPE/NMEMB/NFILE lines, hex-named raw data files, and
+    attr-v2 'name dtype nmemb hex #HUMANE [...]' lines — so snapshots
+    interchange with the C library (reference io/bigfile.py:16)."""
+    import os
+    from nbodykit_tpu.io.bigfile import BigFileWriter, BigFile
+
+    path = str(tmp_path / 'snap')
+    pos = np.arange(30, dtype='<f8').reshape(10, 3)
+    pid = np.arange(10, dtype='<i8')
+    with BigFileWriter(path) as ff:
+        ff.write('Position', pos, nfile=2)
+        ff.write('ID', pid)
+        ff.write_attrs('Header', {
+            'BoxSize': np.array([100.0, 100.0, 100.0]),
+            'Label': 'hello',
+            'Nested': {'a': 1},
+        })
+
+    # block header is the C library's exact text layout
+    with open(os.path.join(path, 'Position', 'header')) as f:
+        lines = f.read().splitlines()
+    assert lines[0] == 'DTYPE: <f8'
+    assert lines[1] == 'NMEMB: 3'
+    assert lines[2] == 'NFILE: 2'
+    assert lines[3].startswith('000000: 5 : ')
+    assert lines[4].startswith('000001: 5 : ')
+    # data files are hex-named raw little-endian bytes
+    raw = open(os.path.join(path, 'Position', '000000'), 'rb').read()
+    np.testing.assert_array_equal(
+        np.frombuffer(raw, '<f8').reshape(5, 3), pos[:5])
+    # checksum is the 32-bit byte sum
+    want = int(np.frombuffer(raw, np.uint8).sum(dtype=np.uint64)
+               & 0xFFFFFFFF)
+    assert lines[3] == '000000: 5 : %d' % want
+
+    # attr-v2: name dtype nmemb hex, trailing #HUMANE comment ignored
+    with open(os.path.join(path, 'Header', 'attr-v2')) as f:
+        attr_lines = f.read().splitlines()
+    by_name = {l.split()[0]: l for l in attr_lines}
+    name, dt, nmemb, hexdata = by_name['BoxSize'].split()[:4]
+    assert (dt, nmemb) == ('<f8', '3')
+    np.testing.assert_array_equal(
+        np.frombuffer(bytes.fromhex(hexdata), '<f8'), 100.0)
+    assert '#HUMANE' in by_name['BoxSize']
+
+    # reader round-trip, including json:// decoding of nested attrs
+    bf = BigFile(path)
+    np.testing.assert_array_equal(bf.read(['Position'], 0, 10)['Position'], pos)
+    np.testing.assert_array_equal(bf.read(['ID'], 2, 7)['ID'], pid[2:7])
+    np.testing.assert_array_equal(bf.attrs['BoxSize'], [100.0] * 3)
+    assert bf.attrs['Label'] == 'hello'
+    assert bf.attrs['Nested'] == {'a': 1}
+
+
+def test_bigfile_reads_foreign_snapshot(tmp_path):
+    """A block written by hand following the published format (as the C
+    library would) must load: the reader cannot depend on any quirk of
+    our own writer."""
+    import os
+    from nbodykit_tpu.io.bigfile import BigFile
+
+    root = str(tmp_path / 'fastpm_snap')
+    bdir = os.path.join(root, '1', 'Position')
+    os.makedirs(bdir)
+    data = np.arange(12, dtype='<f4').reshape(4, 3)
+    with open(os.path.join(bdir, '000000'), 'wb') as f:
+        f.write(data[:1].tobytes())
+    with open(os.path.join(bdir, '000001'), 'wb') as f:
+        f.write(data[1:].tobytes())
+    with open(os.path.join(bdir, 'header'), 'w') as f:
+        f.write('DTYPE: <f4\nNMEMB: 3\nNFILE: 2\n'
+                '000000: 1 : 0\n000001: 3 : 0\n')
+    hdir = os.path.join(root, 'Header')
+    os.makedirs(hdir)
+    with open(os.path.join(hdir, 'header'), 'w') as f:
+        f.write('DTYPE: <i8\nNMEMB: 1\nNFILE: 0\n')
+    with open(os.path.join(hdir, 'attr-v2'), 'w') as f:
+        f.write('Time <f8 1 %s #HUMANE [ 1.0 ]\n'
+                % np.float64(1.0).tobytes().hex().upper())
+
+    bf = BigFile(root, dataset='1', header='Header')
+    got = bf.read(['Position'], 0, 4)['Position']
+    np.testing.assert_array_equal(got, data)
+    assert float(bf.attrs['Time']) == 1.0
