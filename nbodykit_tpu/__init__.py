@@ -31,7 +31,6 @@ __version__ = "0.1.0"
 # global options (reference: nbodykit/__init__.py:22-25, set_options :215-256)
 # ---------------------------------------------------------------------------
 
-_global_options = {}
 _default_options = {
     # dtype used for meshes created via to_mesh() unless overridden
     'mesh_dtype': 'f4',
@@ -45,7 +44,60 @@ _default_options = {
     # (scatter-free sort + segmented reduction; see ops/paint.py)
     'paint_method': 'scatter',
 }
-_global_options.update(_default_options)
+
+
+class _Options(object):
+    """Thread-aware options mapping.
+
+    The main thread reads/writes one shared dict; any other thread
+    (e.g. a TaskManager worker farming tasks to device sub-meshes,
+    batch.py) gets its own copy seeded from the main thread's values at
+    first use — so concurrent tasks using ``set_options`` cannot race
+    each other or corrupt the process-wide defaults.
+    """
+
+    def __init__(self, defaults):
+        import threading
+        self._threading = threading
+        self._main = dict(defaults)
+        self._tls = threading.local()
+
+    def _cur(self):
+        if self._threading.current_thread() is \
+                self._threading.main_thread():
+            return self._main
+        d = getattr(self._tls, 'd', None)
+        if d is None:
+            d = dict(self._main)
+            self._tls.d = d
+        return d
+
+    def __getitem__(self, key):
+        return self._cur()[key]
+
+    def __setitem__(self, key, value):
+        self._cur()[key] = value
+
+    def __contains__(self, key):
+        return key in self._cur()
+
+    def __iter__(self):
+        return iter(self._cur())
+
+    def keys(self):
+        return self._cur().keys()
+
+    def copy(self):
+        return dict(self._cur())
+
+    def update(self, other):
+        self._cur().update(other)
+
+    def clear(self):
+        self._cur().clear()
+
+
+_global_options = _Options(_default_options)
 
 
 class set_options(object):

@@ -242,10 +242,14 @@ def _find_unique_edges(pm, xmax, kind='complex'):
     # of each bin's first occurrence (reference find_unique_local,
     # fftpower.py:743-749) — the centers are exact, not re-quantized
     ix2 = (x2 / binning + 0.5).astype(jnp.int64)
-    _, idx = jnp.unique(ix2, return_index=True,
-                        size=min(x2.size, 1 << 20), fill_value=-1)
-    idx = np.asarray(idx)
-    fx2 = np.asarray(x2[jnp.asarray(idx[idx >= 0])], dtype='f8')
+    vals, idx = jnp.unique(ix2, return_index=True,
+                           size=min(x2.size, 1 << 20), fill_value=-1)
+    # jnp.unique pads `idx` with 0 (not fill_value); the number of real
+    # uniques is how many `vals` slots escaped the -1 fill (x2 >= 0 so
+    # every real quantized value is >= 0)
+    nuniq = int(np.asarray((vals >= 0).sum()))
+    idx = np.asarray(idx)[:nuniq]
+    fx2 = np.asarray(x2[jnp.asarray(idx)], dtype='f8')
     fx = np.sort(np.sqrt(fx2))
     # dedup round-off survivors with a much finer quantum
     iy = np.round(fx / (x0.min() * 1e-5)).astype(np.int64)

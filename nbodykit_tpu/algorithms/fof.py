@@ -7,20 +7,22 @@ reduction (:427-727).
 TPU redesign (no kd-tree, no ragged recursion): a *grid-hash
 label-propagation* FOF that is one jitted XLA program:
 
-1. hash particles to cells of size = linking length; sort by cell
-   (cells are contiguous ranges after the sort);
+1. hash particles to cells of size = linking length, sort by cell and
+   locate neighbor cells by binary search (ops/devicehash.py — no
+   dense cell table, so cells are never coarser than ll);
 2. labels start as particle indices; each sweep takes, for every
    particle, the min label over all particles of the 27 neighbor cells
-   within the linking length (fixed per-cell capacity K = max occupancy,
-   so shapes are static), followed by pointer-jumping (path halving),
-   inside a lax.while_loop until a fixpoint;
+   within the linking length (slot loop = while_loop bounded by the
+   max referenced-cell occupancy), followed by pointer-jumping (path
+   halving), inside a lax.while_loop until a fixpoint;
 3. halo properties (Length, periodic-aware CMPosition, CMVelocity) are
    segment reductions over the final labels; halos are relabeled by
    descending size with label 0 = below ``nmin`` (matching the
    reference's _assign_labels ordering semantics, :197-287).
 
-The sweep cost is N * 27 * K distance checks, fully vectorized; the
-while_loop converges in O(log diameter) sweeps thanks to path halving.
+With a device mesh active the same sweep runs domain-decomposed
+(:func:`_fof_labels_distributed`): slab routing with ghost copies and
+an exchange-based cross-device label merge.
 """
 
 import logging
@@ -33,55 +35,25 @@ from ..utils import as_numpy
 
 
 def _fof_labels(pos, BoxSize, ll, periodic=True):
-    """FOF label computation (jittable sweeps inside).
+    """FOF label computation, single device.
 
     pos : (N, 3) positions (host/device); BoxSize : (3,) floats;
     ll : linking length; periodic : wrap at the box boundary
 
-    Returns (N,) int32 root labels (min particle index per group, in the
-    cell-sorted ordering) mapped back to input order.
+    Returns (N,) int32 root labels (the index of one canonical member
+    per group), in input order. Delegates to the in-graph grid hash
+    (:func:`...ops.devicehash.local_fof_labels`) — binary-search cell
+    lookup with exactly ll-sized cells, so the sweep cost tracks the
+    true local density instead of a capped-cell-size occupancy.
     """
-    from ..ops.gridhash import GridHash
+    from ..ops.devicehash import local_fof_labels
+    pos = jnp.asarray(pos)
     N = pos.shape[0]
-    grid = GridHash(np.asarray(pos), BoxSize, ll, periodic=periodic,
-                    max_ncell=256)
-    order = jnp.asarray(grid.order)
-    pos_s = grid.pos_s
-    ci_s = grid.cell_of(pos_s)
-
-    ll2 = jnp.asarray(ll * ll, pos_s.dtype)
-
-    def neighbor_min(labels):
-        """For each particle: min label among particles within ll."""
-        def body(best, j, valid, d, r2):
-            ok = valid & (r2 <= ll2)
-            cand = jnp.where(ok, labels[j], best)
-            return jnp.minimum(best, cand)
-        return grid.fold(pos_s, ci_s, body, labels)
-
-    labels0 = jnp.arange(N, dtype=jnp.int32)
-
-    def body(state):
-        labels, _ = state
-        new = neighbor_min(labels)
-        # pointer jumping (path halving) — labels are particle indices
-        new = jnp.minimum(new, new[new])
-        new = jnp.minimum(new, new[new])
-        changed = jnp.any(new != labels)
-        return new, changed
-
-    def cond(state):
-        return state[1]
-
-    labels, _ = jax.lax.while_loop(
-        cond, body, (labels0, jnp.asarray(True)))
-
-    # map back to input order: label value refers to sorted index; remap
-    # to a stable id = original index of the root particle
-    root_orig = order[labels]
-    out = jnp.empty(N, dtype=jnp.int32).at[order].set(
-        root_orig.astype(jnp.int32))
-    return out
+    valid = jnp.ones(N, dtype=bool)
+    box = np.asarray(BoxSize, dtype='f8')
+    return jax.jit(
+        lambda p, v: local_fof_labels(p, v, box, float(ll),
+                                      periodic=periodic))(pos, valid)
 
 
 def _fof_labels_distributed(pos, BoxSize, ll, mesh, periodic=True,
@@ -110,7 +82,7 @@ def _fof_labels_distributed(pos, BoxSize, ll, mesh, periodic=True,
     device ever holds the full Position array.
     """
     from ..parallel.domain import (slab_route, scatter_reduce_by_index,
-                                   _padded)
+                                   padded_size, INT32_BIG)
     from ..parallel.runtime import AXIS, mesh_size, shard_leading
     from ..ops.devicehash import local_fof_labels
     from jax.sharding import PartitionSpec as P
@@ -145,7 +117,7 @@ def _fof_labels_distributed(pos, BoxSize, ll, mesh, periodic=True,
             max_ncell=max_ncell))(pos_r, work)
 
     # 3. label merge loop
-    padded, _ = _padded(N, nproc)
+    padded, _ = padded_size(N, nproc)
     glab = shard_leading(mesh, jnp.arange(padded, dtype=jnp.int32))
 
     def seg_min(lab_l, root_l, work_l):
@@ -172,9 +144,6 @@ def _fof_labels_distributed(pos, BoxSize, ll, mesh, periodic=True,
         if not changed:
             break
     return glab[:N]
-
-
-INT32_BIG = np.iinfo('i4').max
 
 
 class FOF(object):
@@ -251,7 +220,7 @@ class FOF(object):
         group counts (int32, for the size-ordered relabeling the
         reference does with mpsort, fof.py:197-287) touch the host."""
         from ..parallel.domain import (scatter_reduce_by_index,
-                                       gather_by_index, _padded)
+                                       gather_by_index)
         from ..parallel.runtime import shard_leading
         mesh = self.comm
         pos = jnp.asarray(self._source['Position'])

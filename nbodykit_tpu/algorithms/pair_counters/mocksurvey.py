@@ -29,6 +29,10 @@ class SurveyDataPairCount(PairCountBase):
                  show_progress=False):
         if mode not in ('1d', '2d', 'projected', 'angular'):
             raise ValueError("invalid mode %r" % mode)
+        if mode == '2d' and Nmu is None:
+            raise ValueError("mode='2d' requires Nmu")
+        if mode == 'projected' and pimax is None:
+            raise ValueError("mode='projected' requires pimax")
         self.comm = first.comm
         self.attrs = dict(mode=mode, edges=np.asarray(edges), Nmu=Nmu,
                           pimax=pimax, weight=weight)

@@ -3,16 +3,22 @@
 Reference: ``nbodykit/batch.py:53`` — splits MPI COMM_WORLD into
 fixed-size worker sub-communicators and runs a master-worker loop with
 point-to-point tags (:172-267). The TPU equivalent of rank-splitting is
-*device sub-meshes*: the available devices are split into groups of
-``cpus_per_task``, each task runs with its sub-mesh pushed as the
-ambient CurrentMesh, and the controller iterates tasks (serially on one
-host — multi-host farming rides jax.distributed in a later round).
+*device sub-meshes*: the available devices are partitioned into groups
+of ``cpus_per_task`` and tasks are farmed to the groups CONCURRENTLY —
+one worker thread per sub-mesh, each with its own thread-local ambient
+:class:`~.parallel.runtime.CurrentMesh`. jax dispatch is asynchronous,
+so work launched on disjoint device groups overlaps on hardware just as
+the reference's worker groups do across ranks; the thread pool plays
+the master role of the reference's READY/DONE tag loop.
 
 API parity: ``with TaskManager(cpus_per_task) as tm:`` then
-``tm.iterate(tasks)`` / ``tm.map(func, tasks)``.
+``tm.iterate(tasks)`` (serial generator on the first sub-mesh) or
+``tm.map(func, tasks)`` (concurrent farming, results in task order).
 """
 
 import logging
+import queue
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -34,13 +40,12 @@ def split_ranks(N_ranks, N_per, include_all=False):
 
 
 class TaskManager(object):
-    """Iterate over tasks, each executed on a sub-mesh of the device
-    mesh.
+    """Farm tasks to sub-meshes of the device mesh.
 
     Parameters
     ----------
     cpus_per_task : devices per task group
-    use_all_cpus : give every task the whole mesh instead
+    use_all_cpus : give every task the whole mesh instead (serial)
     debug : verbose logging
     """
 
@@ -55,17 +60,24 @@ class TaskManager(object):
         self.comm = CurrentMesh.resolve(comm)
         self._ctx = None
 
-    def _sub_mesh(self):
-        import jax
+    def _sub_meshes(self):
+        """Partition the mesh's devices into task groups (the analog of
+        reference split_ranks + comm.Split, batch.py:110-151)."""
         from jax.sharding import Mesh
         if self.comm is None or self.use_all_cpus:
-            return self.comm
+            return [self.comm]
         devs = list(np.asarray(self.comm.devices).ravel())
-        sub = devs[:self.cpus_per_task]
-        return Mesh(np.array(sub), (AXIS,))
+        groups = [devs[i:i + self.cpus_per_task]
+                  for i in range(0, len(devs), self.cpus_per_task)]
+        # drop a trailing partial group (the reference leaves leftover
+        # ranks idle the same way)
+        groups = [g for g in groups if len(g) == self.cpus_per_task] \
+            or groups[:1]
+        return [Mesh(np.array(g), (AXIS,)) for g in groups]
 
     def __enter__(self):
-        self._ctx = use_mesh(self._sub_mesh())
+        self._meshes = self._sub_meshes()
+        self._ctx = use_mesh(self._meshes[0])
         self._ctx.__enter__()
         return self
 
@@ -76,14 +88,35 @@ class TaskManager(object):
 
     def iterate(self, tasks):
         """Iterate over tasks (reference batch.py:268); the ambient
-        mesh inside the loop is the task's sub-mesh."""
+        mesh inside the loop is the first sub-mesh."""
         for task in tasks:
             yield task
 
     def map(self, function, tasks):
-        """Apply ``function`` to every task, returning results in order
-        (reference batch.py:297)."""
-        return [function(task) for task in tasks]
+        """Apply ``function`` to every task, farming tasks over the
+        sub-meshes concurrently; results come back in task order
+        (reference batch.py:297, whose master-worker loop also
+        preserves ordering by index)."""
+        tasks = list(tasks)
+        meshes = getattr(self, '_meshes', None) or self._sub_meshes()
+        if len(meshes) <= 1 or len(tasks) <= 1:
+            return [function(t) for t in tasks]
+
+        pool = queue.Queue()
+        for m in meshes:
+            pool.put(m)
+
+        def run(task):
+            mesh = pool.get()
+            try:
+                with use_mesh(mesh):
+                    self.logger.debug("task on sub-mesh %s", mesh)
+                    return function(task)
+            finally:
+                pool.put(mesh)
+
+        with ThreadPoolExecutor(max_workers=len(meshes)) as ex:
+            return list(ex.map(run, tasks))
 
     def is_root(self):
         return True

@@ -132,9 +132,14 @@ def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True):
     raise ValueError("ghosts must be 'down', 'both' or None")
 
 
-def _padded(size, nproc):
+def padded_size(size, nproc):
+    """(padded_total, per_device) for an index-sharded table of
+    ``size`` entries over ``nproc`` devices."""
     per = -(-size // nproc)
     return per * nproc, per
+
+
+_padded = padded_size
 
 
 def scatter_reduce_by_index(idx, vals, size, mesh, op='add', valid=None,

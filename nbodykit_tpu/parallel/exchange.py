@@ -48,11 +48,13 @@ def auto_capacity(dest, nproc, slack=1.05):
     return int(np.ceil(int(counts.max()) * slack)) + 8
 
 
-def _bucket_local(dest, arrays, nproc, capacity, fill=0.0):
+def _bucket_local(dest, arrays, nproc, capacity, fill=0.0, live=None):
     """Pack per-particle payloads into a (nproc, capacity, ...) send buffer.
 
     dest : (n,) int32 destination device per particle
     arrays : list of (n, ...) payloads
+    live : optional (n,) bool — entries counted by `dropped` (dead
+        padding slots overflowing a bucket are not data loss)
     Returns (buffers, valid, dropped): buffers[i] has shape
     (nproc, capacity, ...); valid is (nproc, capacity) bool.
     """
@@ -65,7 +67,8 @@ def _bucket_local(dest, arrays, nproc, capacity, fill=0.0):
                              side='left')
     rank_in_bucket = idx - start[dest_s]
     ok = rank_in_bucket < capacity
-    dropped = jnp.sum(~ok)
+    lost = ~ok if live is None else (~ok & live[order])
+    dropped = jnp.sum(lost)
     slot = jnp.where(ok, dest_s * capacity + rank_in_bucket, nproc * capacity)
     valid = jnp.zeros((nproc * capacity + 1,), dtype=bool).at[slot].set(True)
     valid = valid[:-1].reshape(nproc, capacity)
@@ -131,8 +134,11 @@ def exchange_by_dest(dest, arrays, mesh, capacity=None, fill=0.0):
     payloads = [live] + list(arrays)
 
     def local(dest_l, *payloads_l):
+        # payloads_l[0] is the live mask: pad entries that overflow a
+        # bucket are not real losses
         bufs, valid, dropped = _bucket_local(dest_l, payloads_l, nproc,
-                                             capacity, fill)
+                                             capacity, fill,
+                                             live=payloads_l[0])
         outs = []
         for b in bufs:
             r = jax.lax.all_to_all(b, AXIS, split_axis=0, concat_axis=0,

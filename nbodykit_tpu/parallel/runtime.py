@@ -16,11 +16,62 @@ Conventions
   API) holding a ``jax.sharding.Mesh``.
 """
 
+import os
+import threading
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = 'dev'
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, local_device_ids=None):
+    """Multi-host bootstrap: connect this process to the global device
+    mesh (the reference's analog is MPI_Init + COMM_WORLD; SURVEY.md
+    §2.2.7 / M8 calls for jax.distributed + multi-slice meshes).
+
+    Arguments default to the standard environment variables
+    (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID), so a
+    launcher (SLURM, GKE, a shell loop of processes) can configure the
+    job without code changes — the moral equivalent of ``srun -n 16
+    python example.py`` in the reference's production jobs
+    (reference nersc/example-job.slurm:11).
+
+    After this call ``jax.devices()`` enumerates the devices of ALL
+    processes and :func:`world_mesh` spans them; jitted collectives ride
+    ICI within a slice and DCN across hosts. No-op when neither
+    arguments nor environment variables request a multi-process setup.
+    """
+    coordinator_address = coordinator_address or \
+        os.environ.get('JAX_COORDINATOR_ADDRESS')
+    if num_processes is None:
+        num_processes = int(os.environ.get('JAX_NUM_PROCESSES', 0)) \
+            or None
+    if process_id is None:
+        pid = os.environ.get('JAX_PROCESS_ID')
+        process_id = int(pid) if pid is not None else None
+    if coordinator_address is None and num_processes is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id,
+        local_device_ids=local_device_ids)
+    return True
+
+
+def world_mesh():
+    """A 1-D mesh over every device of every connected process (the
+    COMM_WORLD analog). Identical to :func:`tpu_mesh` on one process;
+    after :func:`init_distributed` it spans the whole job."""
+    return Mesh(np.array(jax.devices()), (AXIS,))
+
+
+def process_index():
+    """This process's index in the multi-host job (0 on one host) —
+    the 'rank' for host-side work like rank-0-only logging."""
+    return jax.process_index()
 
 
 def single_device_mesh(device=None):
@@ -52,24 +103,46 @@ def tpu_mesh(n=None):
 
 class CurrentMesh(object):
     """A stack of ambient device meshes, mirroring the reference's
-    ``CurrentMPIComm`` stack semantics (nbodykit/__init__.py:107-190)."""
+    ``CurrentMPIComm`` stack semantics (nbodykit/__init__.py:107-190).
 
-    _stack = [None]
+    The stack is *per-thread* so :class:`...batch.TaskManager` can farm
+    tasks to device sub-meshes on worker threads concurrently, each
+    with its own ambient mesh (the reference's analog: per-worker
+    sub-communicators pushed inside TaskManager.__enter__,
+    batch.py:110-151). A thread's stack is seeded with the MAIN
+    thread's current mesh at first use, so user-spawned threads inherit
+    the ambient context instead of silently falling back to
+    single-device.
+    """
+
+    _tls = threading.local()
+    _main_stack = [None]
+
+    @classmethod
+    def _stack(cls):
+        if threading.current_thread() is threading.main_thread():
+            return cls._main_stack
+        st = getattr(cls._tls, 'stack', None)
+        if st is None:
+            st = [cls._main_stack[-1]]
+            cls._tls.stack = st
+        return st
 
     @classmethod
     def get(cls):
         """The current ambient mesh (``None`` → single-device)."""
-        return cls._stack[-1]
+        return cls._stack()[-1]
 
     @classmethod
     def push(cls, mesh):
-        cls._stack.append(mesh)
+        cls._stack().append(mesh)
 
     @classmethod
     def pop(cls):
-        if len(cls._stack) == 1:
+        st = cls._stack()
+        if len(st) == 1:
             raise RuntimeError("cannot pop the root mesh")
-        return cls._stack.pop()
+        return st.pop()
 
     @classmethod
     def resolve(cls, comm):

@@ -1,0 +1,60 @@
+"""Worker process for the multi-host bootstrap test.
+
+Run as:  python _multihost_worker.py <coordinator> <nprocs> <pid>
+
+Connects to the coordination service, builds the world mesh spanning
+both processes' CPU devices, runs the paint -> distributed rFFT
+pipeline on a deterministic particle set, and prints two replicated
+scalars every process must agree on.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main():
+    coord, nprocs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    from nbodykit_tpu.parallel.runtime import init_distributed, \
+        world_mesh
+    if nprocs > 1:
+        assert init_distributed(coordinator_address=coord,
+                                num_processes=nprocs, process_id=pid)
+    mesh = world_mesh()
+    ndev = len(jax.devices())
+
+    from nbodykit_tpu.pmesh import ParticleMesh
+    pm = ParticleMesh(Nmesh=16, BoxSize=50.0, dtype='f4', comm=mesh)
+
+    N = 4096
+    pos_np = np.random.RandomState(7).uniform(0, 50.0, (N, 3)) \
+        .astype('f4')
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from nbodykit_tpu.parallel.runtime import AXIS
+    sharding = NamedSharding(mesh, P(AXIS, None))
+
+    def cb(index):
+        return pos_np[index]
+
+    pos = jax.make_array_from_callback((N, 3), sharding, cb)
+
+    field = pm.paint(pos, 1.0, resampler='cic')
+    total = float(jnp.sum(field.astype(jnp.float32)))
+    c = pm.r2c(field)
+    p2 = float(jnp.sum(jnp.abs(c) ** 2))
+    print("RESULT %d %.6e %.6e" % (ndev, total, p2), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,69 @@
+"""Multi-host (multi-process) bootstrap: the jax.distributed wiring of
+SURVEY §2.2.7/M8 — two coordinated processes with 4 CPU devices each
+must form one 8-device world mesh and agree on the full
+paint -> distributed-rFFT pipeline, matching a single-process run
+(the reference's whole execution model is N MPI processes over one
+program; nersc/example-job.slurm:11)."""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, '_multihost_worker.py')
+
+
+def _run_single():
+    r = subprocess.run(
+        [sys.executable, WORKER, 'none', '1', '0'],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(HERE))
+    assert r.returncode == 0, r.stderr[-2000:]
+    m = re.search(r'RESULT (\d+) (\S+) (\S+)', r.stdout)
+    assert m, r.stdout
+    return int(m.group(1)), float(m.group(2)), float(m.group(3))
+
+
+@pytest.mark.slow
+def test_two_process_world_mesh_matches_single():
+    port = 12357
+    env = dict(os.environ)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, '127.0.0.1:%d' % port, '2',
+             str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(HERE))
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, err[-2000:]
+        outs.append(out)
+
+    results = []
+    for out in outs:
+        m = re.search(r'RESULT (\d+) (\S+) (\S+)', out)
+        assert m, out
+        results.append((int(m.group(1)), float(m.group(2)),
+                        float(m.group(3))))
+
+    # both processes saw the 8-device world and agree exactly
+    assert results[0][0] == 8 and results[1][0] == 8
+    assert results[0] == results[1]
+
+    # and the multi-process pipeline reproduces the single-process run
+    ndev1, total1, p21 = _run_single()
+    assert ndev1 == 4
+    np.testing.assert_allclose(results[0][1], total1, rtol=1e-5)
+    np.testing.assert_allclose(results[0][2], p21, rtol=1e-4)
