@@ -1,0 +1,122 @@
+"""Tests for the Einstein-Boltzmann engine (cosmology/boltzmann.py).
+
+Golden values are published Planck-chain / CLASS-derived numbers
+(z_drag, r_drag, conformal distance), plus internal-consistency checks
+(superhorizon curvature conservation, the 9/10 potential dip, gauge
+suppression of the comoving density) that do not require CLASS.
+"""
+
+import numpy as np
+import pytest
+
+from nbodykit_tpu.cosmology import boltzmann as B
+
+
+def _planckish(**kw):
+    pars = dict(h=0.67556, T0_cmb=2.7255, Omega_b=0.0482754,
+                Omega_cdm=0.263771, m_ncdm=[0.06], N_ur=2.0328)
+    pars.update(kw)
+    return B.Background(**pars)
+
+
+@pytest.fixture(scope='module')
+def bgth():
+    bg = _planckish()
+    return bg, B.Thermodynamics(bg)
+
+
+def test_ncdm_density(bgth):
+    bg, th = bgth
+    # CLASS convention: omega_ncdm = m / 93.14 eV for T_ncdm/T = 0.71611
+    assert np.isclose(bg.Omega_ncdm * bg.h ** 2, 0.06 / 93.14, rtol=2e-4)
+    # relativistic limit at early times: rho -> (7/8) Tr^4 rho_g
+    s = bg.ncdm[0]
+    rel = (7.0 / 8) * B.T_NCDM_RATIO ** 4 * bg.Omega_g
+    assert np.isclose(s.rho_over_rhocrit0(1e-6) * 1e-24, rel, rtol=1e-6)
+
+
+def test_conformal_distance_golden(bgth):
+    """chi(z=1) = 3396.16 Mpc: the reference's own golden value
+    (nbodykit cosmology/tests/test_cosmology.py::test_cosmology_sane,
+    c.tau(1.0) with classylss)."""
+    bg, th = bgth
+    chi = bg.tau(1.0) - bg.tau(0.5)
+    assert np.isclose(chi, 3396.158162, rtol=5e-4)
+
+
+def test_recombination_epochs(bgth):
+    bg, th = bgth
+    # Planck-chain values for essentially these parameters
+    assert abs(th.z_drag - 1060.0) < 8.0
+    assert abs(th.rs_drag - 147.2) < 1.5
+    assert 1060 < th.z_rec < 1105
+    assert th.xe(0.0) > 1.0           # reionized
+    assert th.xe(500.0) < 1e-3        # dark ages
+    assert 0.04 < th.tau_reio < 0.12
+    assert th.Tb(0.0) > 0.0
+    assert th.cs2_b(1.0) >= 0.0
+
+
+def test_superhorizon_curvature_conservation(bgth):
+    """R = phi + 2(phi'/Hc + psi)/(3(1+w)) conserved through equality
+    and the classic phi_MD = (9/10) phi_RD dip (here with neutrinos)."""
+    bg, th = bgth
+    s = B.BoltzmannSolver(bg, th)
+    lna_out = np.sort(np.log(1.0 / (1.0 + np.array([1e5, 50.0]))))
+    out = s.solve_mode(1e-5, lna_out)
+    phi_rd, phi_md = out['phi']
+    # with R_nu ~ 0.41: phi_MD/phi_RD = (9/10)(1 + 4 R_nu/15)/(1 + 2 R_nu/5)
+    rho_g = bg.Omega_g
+    rho_nu = bg.Omega_ur + sum(sp._rel_density for sp in bg.ncdm)
+    R_nu = rho_nu / (rho_g + rho_nu)
+    expect = 0.9 * (1 + 4 * R_nu / 15) / (1 + 2 * R_nu / 5)
+    assert np.isclose(phi_md / phi_rd, expect, rtol=0.015)
+    # absolute normalization: R = 1 -> phi_RD = (2/3)(1 + 2Rnu/5)/(1 + 4Rnu/15)...
+    psi_rd = 10.0 / (15.0 + 4.0 * R_nu)
+    assert np.isclose(phi_rd, (1 + 2 * R_nu / 5) * psi_rd, rtol=0.01)
+
+
+@pytest.mark.slow
+def test_pk_shape_vs_eisenstein_hu():
+    """P(k, z=0) shape within ~6% of the full EH transfer over the
+    quasi-linear range (EH itself is a few-percent approximation and
+    has no neutrino suppression)."""
+    bg = _planckish()
+    th = B.Thermodynamics(bg)
+    eng = B.BoltzmannEngine(bg, th, A_s=2.215e-9, n_s=0.9667,
+                            P_k_max=2.0, cache=False)
+    from nbodykit_tpu.cosmology import Cosmology
+    from nbodykit_tpu.cosmology.power.transfers import EisensteinHu
+    c = Cosmology(h=0.67556, Omega0_b=0.0482754, Omega0_cdm=0.263771,
+                  n_s=0.9667, A_s=2.215e-9, m_ncdm=0.06)
+    T = EisensteinHu(c, 0.0)
+    kh = np.logspace(-4, np.log10(1.5), 25)
+    r = eng.get_pklin(kh, 0.0) / (kh ** 0.9667 * T(kh) ** 2)
+    r = r / r[10]
+    assert np.all(np.abs(r - 1.0) < 0.075), r
+    # sigma8 in the Planck ballpark for this A_s
+    assert 0.80 < eng.sigma8 < 0.86
+
+
+@pytest.mark.slow
+def test_growth_matches_background_ode():
+    """Scale-independent growth from the Boltzmann solve matches the
+    background growth ODE to ~1% (k = 0.15/Mpc is above the neutrino
+    free-streaming scale, so the Boltzmann growth is physically ~1%
+    lower than the all-matter ODE: free-streaming neutrinos do not
+    cluster there but the ODE sources with the full Omega_m)."""
+    bg = _planckish()
+    th = B.Thermodynamics(bg)
+    s = B.BoltzmannSolver(bg, th)
+    zs = np.array([9.0, 1.0, 0.0])
+    lna_out = np.sort(np.log(1 / (1 + zs)))
+    out = s.solve_mode(0.15, lna_out)  # 1/Mpc
+    g_boltz = out['d_cdm'][-1] / out['d_cdm'][0]     # D(0)/D(9)
+    from nbodykit_tpu.cosmology import Cosmology
+    c = Cosmology(h=0.67556, Omega0_b=0.0482754, Omega0_cdm=0.263771,
+                  m_ncdm=0.06)
+    g_ode = (c.scale_independent_growth_factor(0.0)
+             / c.scale_independent_growth_factor(9.0))
+    assert np.isclose(g_boltz, g_ode, rtol=0.02)
+    # and the deficit has the free-streaming sign
+    assert g_boltz < g_ode
