@@ -126,7 +126,7 @@ class Background(object):
 
     def __init__(self, h, T0_cmb, Omega_b, Omega_cdm, Omega_k=0.0,
                  N_ur=3.046, m_ncdm=(), w0_fld=-1.0, wa_fld=0.0,
-                 use_fld=False):
+                 use_fld=False, Omega_lambda=None, Omega_fld=None):
         self.h = float(h)
         self.T0_cmb = float(T0_cmb)
         self.H0 = h * H0_MPC                          # 1/Mpc
@@ -138,20 +138,30 @@ class Background(object):
         self.Omega_k = float(Omega_k)
         self.w0_fld = float(w0_fld)
         self.wa_fld = float(wa_fld)
-        self.use_fld = bool(use_fld)
         self.ncdm = [NcdmSpecies(m, T0_cmb, self.Omega_g)
                      for m in m_ncdm if m]
         self.Omega_ncdm = float(sum(s.rho_over_rhocrit0(1.0)
                                     for s in self.ncdm))
-        self.Omega_de = 1.0 - self.Omega_k - self.Omega_g - self.Omega_ur \
+        budget = 1.0 - self.Omega_k - self.Omega_g - self.Omega_ur \
             - self.Omega_b - self.Omega_cdm - self.Omega_ncdm
+        if Omega_lambda is None and Omega_fld is None:
+            # closure: all dark energy in one component
+            if use_fld:
+                self.Omega_lambda, self.Omega_fld = 0.0, budget
+            else:
+                self.Omega_lambda, self.Omega_fld = budget, 0.0
+        else:
+            self.Omega_lambda = float(Omega_lambda or 0.0)
+            self.Omega_fld = float(Omega_fld or 0.0)
+        self.use_fld = bool(use_fld or self.Omega_fld != 0.0)
+        self.Omega_de = self.Omega_lambda + self.Omega_fld
         self._tau_spl = None
         self._a_of_tau = None
 
     # -- densities (all as rho/rho_crit0) -----------------------------------
 
     def de_factor(self, a):
-        """rho_de(a)/rho_de(0) for CPL."""
+        """rho_fld(a)/rho_fld(0) for CPL."""
         a = np.asarray(a, dtype='f8')
         if not self.use_fld:
             return np.ones_like(a)
@@ -163,7 +173,7 @@ class Background(object):
         E2 = (self.Omega_g + self.Omega_ur) / a ** 4 \
             + (self.Omega_b + self.Omega_cdm) / a ** 3 \
             + self.Omega_k / a ** 2 \
-            + self.Omega_de * self.de_factor(a)
+            + self.Omega_lambda + self.Omega_fld * self.de_factor(a)
         for s in self.ncdm:
             E2 = E2 + s.rho_over_rhocrit0(a)
         return E2
@@ -1083,6 +1093,18 @@ _CACHE_DIR = os.environ.get(
                  'boltzmann'))
 
 
+def tophat_sigma(k, pk, r):
+    """sqrt of the top-hat-filtered variance of a power spectrum:
+    sigma^2(r) = (1/2 pi^2) int dlnk k^3 P(k) W(kr)^2, with k a
+    log-spaced grid in h/Mpc, P in (Mpc/h)^3, r in Mpc/h.  Shared by
+    every sigma_r in the package (engine, LinearPower, EH amplitude)."""
+    lnk = np.log(k)
+    x = k * r
+    w = 3.0 * (np.sin(x) - x * np.cos(x)) / x ** 3
+    return float(np.sqrt(np.trapezoid(pk * (w * k) ** 2 * k, lnk)
+                         / (2 * np.pi ** 2)))
+
+
 def _default_kgrid(kmax_mpc):
     """1/Mpc k grid: log ends + linear BAO sampling (dk resolves the
     ~2pi/r_s ~ 0.04/Mpc wiggle period)."""
@@ -1135,11 +1157,17 @@ class BoltzmannEngine(object):
     def _solve_tables(self):
         if self._tables is not None:
             return self._tables
+        # shipped tables for the built-in parameter sets (VERDICT r1
+        # item 5: precomputed transfer tables in-repo), then the user
+        # cache
+        shipped = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               'data', self._key() + '.npz')
         path = os.path.join(_CACHE_DIR, self._key() + '.npz')
-        if self._cache and os.path.exists(path):
-            d = np.load(path)
-            self._tables = {k: d[k] for k in d.files}
-            return self._tables
+        for p in (shipped, path):
+            if self._cache and os.path.exists(p):
+                d = np.load(p)
+                self._tables = {k: d[k] for k in d.files}
+                return self._tables
 
         solver = BoltzmannSolver(self.bg, self.th, **self._solver_kwargs)
         kgrid = _default_kgrid(self.P_k_max * self.bg.h)
@@ -1246,14 +1274,10 @@ class BoltzmannEngine(object):
 
     def sigma_r(self, r_hmpc, z=0.0, which='m'):
         """Tophat rms fluctuation; r in Mpc/h."""
-        lnk = np.linspace(np.log(1e-5), np.log(self.P_k_max * 0.999),
-                          1024)
-        k = np.exp(lnk)
-        pk = self.get_pklin(k, z, which=which)
-        x = k * r_hmpc
-        w = 3.0 * (np.sin(x) - x * np.cos(x)) / x ** 3
-        integ = pk * (w * k) ** 2 * k
-        return float(np.sqrt(np.trapezoid(integ, lnk) / (2 * np.pi ** 2)))
+        k = np.exp(np.linspace(np.log(1e-5),
+                               np.log(self.P_k_max * 0.999), 1024))
+        return tophat_sigma(k, self.get_pklin(k, z, which=which),
+                            r_hmpc)
 
     _sigma8 = None
 

@@ -28,7 +28,7 @@ class FNLGalaxyPower(object):
     """
 
     def __init__(self, cosmo, redshift, b1=2.0, fnl=0.0, p=1.0,
-                 transfer='EisensteinHu'):
+                 transfer='CLASS'):
         self.cosmo = cosmo
         self.redshift = float(redshift)
         self.b1 = b1
@@ -44,12 +44,12 @@ class FNLGalaxyPower(object):
         so D(a) = a in matter domination (the g(z) convention)."""
         k = np.asarray(k, dtype='f8')
         c = self.cosmo
-        D = c.scale_independent_growth_factor(self.redshift)
-        # normalize D to the matter-domination convention: D(a)*(1+z) -> 1
-        # deep in MD; approximate with D at z=50 anchor
+        # the transfer classes apply D(redshift) internally
+        # (transfers.py:144,187), so only the matter-domination
+        # renormalization Dmd = D(z_md) (1+z_md) remains here
         z_md = 50.0
         Dmd = c.scale_independent_growth_factor(z_md) * (1 + z_md)
-        g = D * Dmd
+        g = Dmd
         T = self._transfer(k)
         H0 = 100.0  # h km/s/Mpc
         with np.errstate(divide='ignore'):

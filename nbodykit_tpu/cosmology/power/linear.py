@@ -1,11 +1,24 @@
 """Linear matter power spectrum.
 
-Reference: ``nbodykit/cosmology/power/linear.py:5`` (LinearPower) with
-transfer selection and sigma8/sigma_r normalization machinery.
+Reference: ``nbodykit/cosmology/power/linear.py:5`` (LinearPower):
+transfer selection ('CLASS' | 'EisensteinHu' | 'NoWiggleEisensteinHu'),
+sigma8 normalization at z=0, assignable ``sigma8``/``redshift``.
+
+Normalization:
+
+- ``transfer='CLASS'``: the amplitude is ``cosmo.sigma8`` (computed
+  from A_s by the Boltzmann engine), exactly the reference's scheme
+  (``linear.py:57-63``: ``_norm = (sigma8/sigma_r(8, z=0))^2``).
+- EH transfers: the reference still normalizes with the CLASS sigma8;
+  here the EH path stays Boltzmann-free by computing the amplitude
+  analytically from A_s via the exact matter-era relation
+  ``delta_m(k) = (2/5) (k^2/(Omega_m H0^2)) T(k) D_md(z)`` with
+  ``D_md`` the matter+Lambda growth normalized to ``a`` in matter
+  domination.  This agrees with the Boltzmann sigma8 to within the
+  EH transfer accuracy (a few percent).
 """
 
 import numpy as np
-from scipy import integrate
 
 from . import transfers as _transfers
 
@@ -17,89 +30,143 @@ class LinearPower(object):
     ----------
     cosmo : Cosmology
     redshift : float
-    transfer : 'EisensteinHu' (default here) | 'NoWiggleEisensteinHu' |
-        'CLASS' (unavailable in this environment)
-
-    The amplitude is set from A_s at construction; assigning
-    :attr:`sigma8` rescales to match (reference semantics).
+    transfer : 'CLASS' (default) | 'EisensteinHu' |
+        'NoWiggleEisensteinHu'
     """
 
-    def __init__(self, cosmo, redshift, transfer='EisensteinHu'):
+    def __init__(self, cosmo, redshift, transfer='CLASS'):
+        if transfer not in _transfers.available:
+            raise ValueError("'transfer' should be one of %s"
+                             % _transfers.available)
         self.cosmo = cosmo
-        self.redshift = float(redshift)
         self.transfer = transfer
-        cls = getattr(_transfers, transfer, None)
-        if cls is None:
-            raise ValueError("unknown transfer %r" % transfer)
-        self._transfer = cls(cosmo, redshift)
+        self._transfer = getattr(_transfers, transfer)(cosmo, redshift)
+        # EH fallback for k beyond the CLASS table range
+        self._fallback = _transfers.EisensteinHu(cosmo, redshift)
+        self.attrs = dict(cosmo=dict(cosmo.attrs)
+                          if hasattr(cosmo, 'attrs') else {},
+                          redshift=redshift, transfer=transfer)
+
         self._norm = 1.0
-        self.attrs = dict(cosmo=dict(cosmo.attrs), redshift=redshift,
-                          transfer=transfer)
+        self._z = 0.0
+        self._set_redshift(0.0)
+        if transfer == 'CLASS':
+            self._sigma8 = cosmo.sigma8
+        else:
+            self._sigma8 = self._As_sigma8()
+        self._norm = (self._sigma8 / self.sigma_r(8.0)) ** 2
+        self._set_redshift(redshift)
+        self.attrs['sigma8'] = self._sigma8
 
-        # amplitude from the primordial spectrum: the EH transfer already
-        # encodes the shape; fix the normalization via sigma8 computed
-        # from A_s using the standard primordial->matter relation, or
-        # fall back to direct integration with an A_s-based prefactor.
-        self._norm = 1.0
-        self._sigma8_unnorm = self._sigma_r_unnorm(8.0)
-        # A_s-based amplitude: sigma8^2 proportional to A_s; use the
-        # growth-normalized approximation anchored to Planck-like
-        # numbers (sigma8 ~ 0.83 at A_s ~ 2.1e-9 for Planck15 shape).
-        sigma8_from_As = 0.8288 * np.sqrt(cosmo.A_s / 2.1e-9) \
-            * self._shape_correction()
-        self._norm = (sigma8_from_As / self._sigma8_unnorm) ** 2
-        D = cosmo.scale_independent_growth_factor(self.redshift)
-        self._norm *= D ** 2
+    # -- A_s-based amplitude for the Boltzmann-free EH path ---------------
 
-    def _shape_correction(self):
-        # mild adjustment for non-fiducial shapes: keep proportionality
-        # exact in A_s; shape factors absorbed into sigma8 matching via
-        # .sigma8 assignment when precision matters
-        return 1.0
+    def _As_sigma8(self):
+        """sigma8 from A_s via the analytic matter-era normalization."""
+        c = self.cosmo
+        from ..background import MatterDominated
+        md = MatterDominated(Omega0_m=c.Omega0_m,
+                             Omega0_lambda=c.Omega0_lambda,
+                             Omega0_k=c.Omega0_k)
+        # D normalized to a in matter domination: D1 has D(1)=1, so
+        # D_md(1) = a_early / D1(a_early)
+        g0 = float(1e-3 / md.D1(1e-3))
+        H0 = 1.0 / 2997.92458                # h/Mpc
+        k_pivot = getattr(c, 'k_pivot', 0.05)
 
-    def _unnorm_pk(self, k):
-        k = np.asarray(k, dtype='f8')
-        T = self._transfer(k)
-        with np.errstate(divide='ignore'):
-            pk = np.where(k > 0, k ** self.cosmo.n_s * T * T, 0.0)
-        return pk
+        from ..boltzmann import tophat_sigma
+        k = np.exp(np.linspace(np.log(1e-5), np.log(20.0), 4096))
+        T = self._fallback(k)
+        prim = c.A_s * (k * c.h / k_pivot) ** (c.n_s - 1.0)
+        delta = 0.4 * (k * k / (c.Omega0_m * H0 * H0)) * T * g0
+        # k in h/Mpc throughout -> P directly in (Mpc/h)^3
+        pk = 2 * np.pi ** 2 / k ** 3 * prim * delta ** 2
+        return tophat_sigma(k, pk, 8.0)
 
-    def _sigma_r_unnorm(self, r):
-        def integrand(lnk):
-            k = np.exp(lnk)
-            x = k * r
-            w = 3.0 * (np.sin(x) - x * np.cos(x)) / x ** 3
-            return self._unnorm_pk(k) * (w * k) ** 2 * k
-        lnk = np.linspace(np.log(1e-5), np.log(100.0), 4096)
-        vals = integrand(lnk)
-        return np.sqrt(np.trapezoid(vals, lnk) / (2 * np.pi ** 2))
+    # -- redshift / sigma8 surgery (reference semantics) ------------------
+
+    def _set_redshift(self, z):
+        self._z = float(z)
+        self._transfer.redshift = self._z
+        self._fallback.redshift = self._z
+
+    @property
+    def redshift(self):
+        return self._z
+
+    @redshift.setter
+    def redshift(self, value):
+        self._set_redshift(value)
+        self.attrs['redshift'] = value
+        self._table = None
 
     @property
     def sigma8(self):
-        """sigma8 at :attr:`redshift` under the current normalization."""
-        return np.sqrt(self._norm) * self._sigma8_unnorm
+        """The z=0 amplitude; assigning rescales the spectrum."""
+        return self._sigma8
 
     @sigma8.setter
     def sigma8(self, value):
-        self._norm = (value / self._sigma8_unnorm) ** 2
+        self._norm *= (value / self._sigma8) ** 2
+        self._sigma8 = value
+        self.attrs['sigma8'] = value
+        self._table = None
 
-    def sigma_r(self, r):
-        """rms fluctuation in top-hat spheres of radius r Mpc/h."""
-        return np.sqrt(self._norm) * self._sigma_r_unnorm(r)
+    # -- evaluation --------------------------------------------------------
+
+    def _unnorm_pk(self, k, z):
+        """k^ns T(k, z)^2 with EH fallback beyond the table range."""
+        k = np.asarray(k, dtype='f8')
+        save = self._z
+        if z != save:
+            self._set_redshift(z)
+        try:
+            if self.transfer == 'CLASS':
+                kmax = getattr(self.cosmo, 'P_k_max', np.inf)
+                T = np.where(k < 0.999 * kmax, self._transfer(k),
+                             np.nan)
+                bad = ~np.isfinite(T)
+                if np.any(bad):
+                    # continuity-matched EH fallback at high k
+                    kj = 0.999 * kmax
+                    ratio = self._transfer(kj) / self._fallback(kj)
+                    T = np.where(bad, self._fallback(k) * ratio, T)
+            else:
+                T = self._transfer(k)
+        finally:
+            if z != save:
+                self._set_redshift(save)
+        with np.errstate(divide='ignore'):
+            return np.where(k > 0, k ** self.cosmo.n_s * T * T, 0.0)
+
+    def sigma_r(self, r, kmin=1e-5, kmax=1e1):
+        """rms fluctuation in top-hat spheres of radius r Mpc/h at
+        :attr:`redshift` (reference linear.py sigma_r)."""
+        from ..boltzmann import tophat_sigma
+        k = np.exp(np.linspace(np.log(kmin), np.log(kmax), 2048))
+        return tophat_sigma(k, self._norm * self._unnorm_pk(k, self._z),
+                            r)
+
+    def velocity_dispersion(self, kmin=1e-5, kmax=10.0):
+        """1D linear velocity dispersion sigma_v in Mpc/h:
+        sigma_v^2 = (1/6 pi^2) int P(k) dk (reference linear.py
+        velocity_dispersion)."""
+        lnk = np.linspace(np.log(kmin), np.log(kmax), 2048)
+        k = np.exp(lnk)
+        pk = self._norm * self._unnorm_pk(k, self._z)
+        val = np.trapezoid(pk * k, lnk) / (6 * np.pi ** 2)
+        return float(np.sqrt(val))
 
     def __call__(self, k):
         """P(k) in (Mpc/h)^3, k in h/Mpc. Accepts numpy or jax arrays
-        (computed in numpy on host; wrap with jnp.interp tables for
-        in-graph use — see :meth:`to_table`)."""
+        (jax arrays are evaluated via an interpolation table)."""
         import jax.numpy as jnp
         if isinstance(k, jnp.ndarray) and not isinstance(k, np.ndarray):
-            # build an interpolation table once and evaluate in-graph
             lnk_t, lnp_t = self.to_table()
             lk = jnp.log(jnp.maximum(k, 1e-30))
             out = jnp.exp(jnp.interp(lk, jnp.asarray(lnk_t),
                                      jnp.asarray(lnp_t)))
             return jnp.where(k > 0, out, 0.0)
-        return self._norm * self._unnorm_pk(k)
+        return self._norm * self._unnorm_pk(k, self._z)
 
     _table = None
 
@@ -107,16 +174,23 @@ class LinearPower(object):
         """(ln k, ln P) table for in-graph interpolation."""
         if self._table is None:
             lnk = np.linspace(np.log(kmin), np.log(kmax), n)
-            pk = self._norm * self._unnorm_pk(np.exp(lnk))
+            pk = self._norm * self._unnorm_pk(np.exp(lnk), self._z)
             self._table = (lnk, np.log(np.maximum(pk, 1e-300)))
         return self._table
 
 
 def EHPower(cosmo, redshift):
-    """Convenience: LinearPower with the wiggly EH transfer (the
-    reference exposes the same helper)."""
+    """Deprecated alias: LinearPower with the wiggly EH transfer
+    (reference linear.py:200)."""
+    import warnings
+    warnings.warn("EHPower is deprecated; use "
+                  "LinearPower(transfer='EisensteinHu')", FutureWarning)
     return LinearPower(cosmo, redshift, transfer='EisensteinHu')
 
 
 def NoWiggleEHPower(cosmo, redshift):
+    import warnings
+    warnings.warn("NoWiggleEHPower is deprecated; use "
+                  "LinearPower(transfer='NoWiggleEisensteinHu')",
+                  FutureWarning)
     return LinearPower(cosmo, redshift, transfer='NoWiggleEisensteinHu')

@@ -1,16 +1,50 @@
 """Matter transfer functions.
 
 Reference: ``nbodykit/cosmology/power/transfers.py`` — CLASS (:8),
-EisensteinHu (:73), NoWiggleEisensteinHu (:184). Without a Boltzmann
-code in this environment, the analytic Eisenstein & Hu 1998
-(astro-ph/9709112) forms are primary (the reference treats them as
-first-class options too); the formulas below are implemented from the
-published paper.
+EisensteinHu (:73), NoWiggleEisensteinHu (:184). The CLASS transfer is
+served by the in-repo Einstein-Boltzmann engine
+(``cosmology/boltzmann.py``); the analytic Eisenstein & Hu 1998
+(astro-ph/9709112) forms are implemented from the published paper.
 
 All transfers are normalized to T -> 1 as k -> 0 and take k in h/Mpc.
 """
 
 import numpy as np
+
+available = ['CLASS', 'EisensteinHu', 'NoWiggleEisensteinHu']
+
+# minimum k value representing k -> 0 (reference transfers.py:6)
+KMIN = 1e-8
+
+
+class CLASS(object):
+    """The linear matter transfer from the Boltzmann engine:
+    ``T(k) = sqrt(P_lin(k)/k^ns)`` normalized to unity at low k at
+    z = 0 (reference transfers.py:9-73)."""
+
+    def __init__(self, cosmo, redshift):
+        self.cosmo = cosmo
+        self._norm = 1.0
+        self.redshift = 0
+        self._norm = 1.0 / self(KMIN)
+        self.redshift = redshift
+
+    def __call__(self, k):
+        k = np.asarray(k, dtype='f8')
+        scalar = k.ndim == 0
+        k = np.atleast_1d(k)
+        nonzero = k > 0
+        # P in (Mpc/h)^3 -> Mpc^3; primordial in 1/Mpc units
+        linearP = self.cosmo.get_pklin(
+            np.maximum(k, KMIN), self.redshift) / self.cosmo.h ** 3
+        primordialP = (np.maximum(k, KMIN) * self.cosmo.h) \
+            ** self.cosmo.n_s
+        Tk = np.ones(k.shape)
+        D = self.cosmo.scale_independent_growth_factor(self.redshift)
+        Tk[~nonzero] = 1.0 * D
+        Tk[nonzero] = self._norm * np.sqrt(
+            np.maximum(linearP / primordialP, 0.0))[nonzero]
+        return Tk[0] if scalar else Tk
 
 
 class EisensteinHu(object):
@@ -107,7 +141,9 @@ class EisensteinHu(object):
 
         T = self.f_baryon * Tb + (1 - self.f_baryon) * Tc
         out = np.where(valid, T, 1.0)
-        return out
+        # reference transfers.py:182: growth applied inside the transfer
+        return out * self.cosmo.scale_independent_growth_factor(
+            self.redshift)
 
 
 class NoWiggleEisensteinHu(object):
@@ -147,15 +183,8 @@ class NoWiggleEisensteinHu(object):
         L0 = np.log(2 * np.e + 1.8 * q)
         C0 = 14.2 + 731.0 / (1 + 62.5 * q)
         T = L0 / (L0 + C0 * q * q)
-        return np.where(valid, T, 1.0)
+        # reference transfers.py:255: growth applied inside the transfer
+        return np.where(valid, T, 1.0) \
+            * self.cosmo.scale_independent_growth_factor(self.redshift)
 
 
-class CLASS(object):
-    """Placeholder for a Boltzmann-code transfer; raises with guidance
-    (the reference's default when classylss is present,
-    transfers.py:8)."""
-
-    def __init__(self, cosmo, redshift=0):
-        raise NotImplementedError(
-            "no Boltzmann code in this environment; use "
-            "transfer='EisensteinHu' or 'NoWiggleEisensteinHu'")

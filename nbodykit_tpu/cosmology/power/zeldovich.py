@@ -36,7 +36,7 @@ class ZeldovichPower(object):
     nmax : maximum order in the Bessel tower (default 32)
     """
 
-    def __init__(self, cosmo, redshift, transfer='EisensteinHu', nmax=32):
+    def __init__(self, cosmo, redshift, transfer='CLASS', nmax=32):
         self.cosmo = cosmo
         self.redshift = float(redshift)
         self.linear = LinearPower(cosmo, redshift, transfer=transfer)

@@ -52,7 +52,7 @@ def test_real_ylm_orthonormal():
 
 @pytest.fixture(scope='module')
 def fkp_setup():
-    Plin = LinearPower(Planck15, 0.55)
+    Plin = LinearPower(Planck15, 0.55, transfer='EisensteinHu')
     Plin.sigma8 = 0.8
     data = LogNormalCatalog(Plin=Plin, nbar=5e-4, BoxSize=256., Nmesh=32,
                             bias=2.0, seed=11)

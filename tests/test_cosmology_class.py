@@ -1,0 +1,330 @@
+"""Reference-parity tests for the Cosmology class surface.
+
+Ported from ``nbodykit/cosmology/tests/test_cosmology.py`` — the same
+behaviors (parameter aliases, deprecated syntax, conflicts,
+immutability, density relations, astropy-compat names, pickling), with
+engine-backed spectra checks in the slow tier.
+"""
+
+import pickle
+import warnings
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose, assert_array_equal
+
+from nbodykit_tpu.cosmology import Cosmology, Planck15, WMAP9
+
+
+def test_old_Omega_syntax():
+    c1 = Cosmology(Omega_b=0.04)
+    c2 = Cosmology(Omega0_b=0.04)
+    assert c1.Omega0_b == c2.Omega0_b
+
+    c1 = Cosmology(T_cmb=2.7)
+    c2 = Cosmology(T0_cmb=2.7)
+    assert c1.T0_cmb == c2.T0_cmb
+
+    c1 = Cosmology(Omega0_k=0.05)
+    c2 = Cosmology(Omega_k=0.05)
+    assert c1.Omega0_k == c2.Omega0_k
+
+    c1 = Cosmology(Omega0_lambda=0.7)
+    c2 = Cosmology(Omega_lambda=0.7)
+    c3 = Cosmology(Omega0_Lambda=0.7)
+    assert c1.Omega0_lambda == c2.Omega0_lambda
+    assert c1.Omega0_lambda == c3.Omega0_lambda
+
+
+def test_deprecated_init():
+    with pytest.warns(FutureWarning):
+        c1 = Cosmology(H0=67.6, Om0=0.31, flat=True)
+        c2 = Cosmology(H0=67.6, Om0=0.31, Ode0=0.7, flat=False, w0=-0.9)
+
+    with pytest.raises(Exception):
+        Cosmology(h=0.7, flat=True)
+
+    with pytest.raises(Exception):
+        Cosmology(0.7, flat=True)
+
+    with pytest.raises(Exception):
+        Cosmology(H0=70., flat=True, h=0.7)
+
+    assert_allclose(c1.h, 0.676)
+    assert_allclose(c2.h, 0.676)
+    assert_allclose(c1.Om0, 0.31)
+    assert_allclose(c2.Om0, 0.31)
+    assert_allclose(c1.Ok0, 0.)
+    assert_allclose(c2.Ode0, 0.7)
+    assert_allclose(c2.w0_fld, -0.9)
+
+
+def test_conflicts():
+    with pytest.raises(Exception):
+        Cosmology(h=0.7, H0=70)
+    with pytest.raises(Exception):
+        Cosmology(Omega0_b=0.04, Omega_b=0.04)
+    with pytest.raises(Exception):
+        Cosmology(Omega0_b=0.04, omega_b=0.02)
+
+
+def test_unknown_params():
+    with pytest.warns(UserWarning):
+        Cosmology(unknown_paramter=100.)
+
+
+def test_bad_input():
+    with pytest.raises(ValueError):
+        Cosmology(gauge='BAD')
+    with pytest.raises(ValueError):
+        Cosmology(Omega_Lambda=0.7, w0_fld=-0.9)
+
+
+def test_massive_neutrinos():
+    c = Cosmology(m_ncdm=0.6)
+    assert c.N_ncdm == 1
+    with pytest.raises(ValueError):
+        Cosmology(m_ncdm=[0.6, 0.])
+
+
+def test_no_massive_neutrinos():
+    c = Cosmology(m_ncdm=None)
+    assert c.has_massive_nu is False
+    # N_ur default switches to 3.046 with no massive species
+    assert_allclose(c.N_ur, 3.046)
+
+
+def test_N_ur_inference():
+    # reference docstring: 1 massive nu + default T_ncdm -> N_ur=2.0328
+    c = Cosmology()
+    assert c.N_ncdm == 1
+    assert_allclose(c.N_ur, 2.0328)
+    assert_allclose(c.Neff, 3.046, rtol=1e-2)
+
+
+def test_from_file(tmp_path):
+    f = tmp_path / "par.ini"
+    f.write_text("H0=70\nomega_b = 0.0266691\nomega_cdm = 0.110616\n"
+                 "T_cmb=2.7255\n")
+    c = Cosmology.from_file(str(f))
+    assert_allclose(c.Omega0_b * c.h ** 2, 0.0266691)
+    assert_allclose(c.Omega0_cdm * c.h ** 2, 0.110616)
+
+    c2 = c.clone(Omega0_b=0.04)
+    assert_allclose(c2.Omega0_b, 0.04)
+
+    s = pickle.dumps(c)
+    c1 = pickle.loads(s)
+    assert_allclose(c.Omega0_cdm, c1.Omega0_cdm)
+    assert_allclose(c.Omega0_b, c1.Omega0_b)
+
+
+def test_clone():
+    c = Cosmology(gauge='synchronous')
+    c2 = c.clone(Omega0_b=0.04)
+    assert_allclose(c2.Omega0_b, 0.04)
+    c2 = c2.clone()
+    assert_allclose(c2.Omega0_b, 0.04)
+
+
+def test_cosmology_sane():
+    c = Cosmology(gauge='synchronous')
+    assert_allclose(c.Omega_cdm(0), c.Omega0_cdm)
+    assert_allclose(c.Omega_g(0), c.Omega0_g)
+    assert_allclose(c.Omega_b(0), c.Omega0_b)
+    assert_allclose(c.Omega_ncdm(0), c.Omega0_ncdm)
+    assert_allclose(c.Omega_ur(0), c.Omega0_ur)
+    assert_allclose(c.Omega_ncdm(0), c.Omega0_ncdm_tot)
+    assert_allclose(c.Omega_pncdm(0), c.Omega0_pncdm)
+    assert_allclose(c.Omega_m(0), c.Omega0_m)
+    assert_allclose(c.Omega_r(0), c.Omega0_r)
+
+    # total density in 1e10 Msun/h units (reference golden value)
+    assert_allclose(c.rho_crit(0), 27.754999, rtol=1e-6)
+
+    # conformal time in Mpc: the reference's classylss golden value
+    assert_allclose(c.tau(1.0), 3396.158162, rtol=1e-4)
+    assert_allclose(c.comoving_distance(1.0), c.tau(1.0) * c.h)
+
+    assert_allclose(c.efunc(0), 1.)
+    assert_allclose(c.efunc(0) - c.efunc(1 / 0.9999 - 1),
+                    0.0001 * c.efunc_prime(0), rtol=1e-3)
+
+
+def test_efunc_prime():
+    epsilon = 1e-4
+    z = np.linspace(0, 3, 100) + epsilon
+    for cosmo in [WMAP9, Planck15]:
+        d1 = cosmo.efunc_prime(z)
+        d2 = (cosmo.efunc(z + epsilon)
+              - cosmo.efunc(z - epsilon)) / (2 * epsilon) \
+            * -(1 + z) ** 2
+        assert_allclose(d1, d2, rtol=1e-3)
+
+
+def test_cosmology_density():
+    c = Cosmology(gauge='synchronous')
+    z = [0, 1, 2, 5, 9, 99]
+    assert_allclose(c.rho_cdm(z), c.Omega_cdm(z) * c.rho_crit(z))
+    assert_allclose(c.rho_g(z), c.Omega_g(z) * c.rho_crit(z))
+    assert_allclose(c.rho_ncdm(z), c.Omega_ncdm(z) * c.rho_crit(z))
+    assert_allclose(c.rho_b(z), c.Omega_b(z) * c.rho_crit(z))
+    assert_allclose(c.rho_m(z), c.Omega_m(z) * c.rho_crit(z))
+    assert_allclose(c.rho_r(z), c.Omega_r(z) * c.rho_crit(z))
+    assert_allclose(c.rho_ur(z), c.Omega_ur(z) * c.rho_crit(z))
+
+
+def test_cosmology_vect():
+    c = Cosmology(gauge='synchronous')
+    assert_allclose(c.Omega_cdm([0]), c.Omega0_cdm)
+    assert_array_equal(c.Omega_cdm([]).shape, [0])
+    assert_array_equal(c.Omega_cdm([0]).shape, [1])
+    assert_array_equal(c.Omega_cdm([[0]]).shape, [1, 1])
+    assert_array_equal(c.rho_k([[0]]).shape, [1, 1])
+
+
+def test_immutable():
+    c = Cosmology()
+    with pytest.raises(ValueError):
+        c.A_s = 2e-9
+    c.test = 'TEST'  # non-parameter attributes are allowed
+    assert c.test == 'TEST'
+
+
+def test_cosmology_dir():
+    c = Cosmology()
+    d = dir(c)
+    assert "Background" in d
+    assert "Spectra" in d
+    assert "Omega0_m" in d
+
+
+def test_cosmology_pickle():
+    c = Cosmology()
+    c1 = pickle.loads(pickle.dumps(c))
+    assert c1.parameter_file == c.parameter_file
+
+
+def test_parameter_file():
+    c1 = Cosmology(gauge='newtonian')
+    assert 'newtonian' in c1.parameter_file
+    c2 = Cosmology(P_k_max=1.01234567)
+    assert '1.01234567' in c2.parameter_file
+
+
+def test_astropy_compat():
+    c = Cosmology(gauge='synchronous', m_ncdm=[0.06])
+    assert_allclose(c.Odm(0), c.Odm0)
+    assert_allclose(c.Ogamma(0), c.Ogamma0)
+    assert_allclose(c.Ob(0), c.Ob0)
+    assert_allclose(c.Onu(0), c.Onu0)
+    assert_allclose(c.Ok(0), c.Ok0)
+    assert_allclose(c.Ode(0), c.Ode0)
+    assert c.has_massive_nu is True
+
+
+def test_wcdm():
+    c = Cosmology(w0_fld=-0.9, wa_fld=0.1)
+    assert c.Omega0_lambda == 0.0
+    assert c.Omega0_fld > 0
+    assert_allclose(c.Omega0_fld + c.Omega0_m + c.Omega0_r
+                    + c.Omega0_k, 1.0, rtol=1e-8)
+    # fld density evolves
+    assert c.Omega_fld(1.0) != c.Omega0_fld
+
+
+def test_match_omega():
+    c = Cosmology().match(Omega0_cb=0.4)
+    assert_allclose(c.Omega0_cb, 0.4)
+    c = Cosmology().match(Omega0_m=0.4)
+    assert_allclose(c.Omega0_m, 0.4)
+
+
+def test_tau_reio_input():
+    """tau_reio input inverts to z_reio (slow-ish root find)."""
+    c = Cosmology(tau_reio=0.066)
+    assert_allclose(c.tau_reio, 0.066, atol=2e-3)
+    assert 5.0 < c.z_reio < 12.0
+
+
+@pytest.mark.slow
+def test_set_sigma8():
+    c = Cosmology(P_k_max=2.0).match(sigma8=0.80)
+    assert_allclose(c.sigma8, 0.80, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_sigma8_z():
+    z = np.linspace(0, 1, 12)
+    c = Cosmology(P_k_max=2.0)
+    s8_z = c.sigma8_z(z)
+    D_z = c.scale_independent_growth_factor(z)
+    assert_allclose(s8_z, D_z * c.sigma8, rtol=5e-3)
+
+
+@pytest.mark.slow
+def test_cosmology_transfer():
+    c = Cosmology(P_k_max=2.0)
+    t = c.get_transfer(z=0)
+    assert 'h_prime' in t.keys()
+    assert 'k' in t.keys()
+    assert 'd_cdm' in t.keys()
+
+
+@pytest.mark.slow
+def test_cosmology_get_pk():
+    c = Cosmology(P_k_max=2.0)
+    p = c.get_pk(z=0, k=0.1)
+    p1 = c.Spectra.get_pk(z=0, k=0.1)
+    assert_allclose(p, p1)
+    # vectorized meshgrid form (reference test_cosmology_vect)
+    k, z = np.meshgrid([0.05, 0.1], [0.01, 0.05, 0.1, 0.5],
+                       sparse=True, indexing='ij')
+    pk = c.get_pk(z=z, k=k)
+    assert_array_equal(pk.shape, [2, 4])
+
+
+@pytest.mark.slow
+def test_linear_class_goldens():
+    """Reference test_power.py::test_linear golden values (computed
+    there with CLASS): velocity dispersion 5.898 Mpc/h at sigma8=0.82,
+    and sigma_r(8) == sigma8 by normalization."""
+    from nbodykit_tpu.cosmology import LinearPower
+    c = Cosmology().match(sigma8=0.82)
+    P = LinearPower(c, redshift=0, transfer='CLASS')
+    assert_allclose(P.sigma_r(8.), c.sigma8, rtol=1e-4)
+    assert_allclose(P.velocity_dispersion(), 5.898, rtol=0.015)
+
+
+@pytest.mark.slow
+def test_linear_norm_class():
+    """Reference test_power.py::test_linear_norm on the CLASS path."""
+    from nbodykit_tpu.cosmology import LinearPower
+    c = Cosmology().match(sigma8=0.82)
+    P = LinearPower(c, redshift=0, transfer='CLASS')
+    k = np.logspace(-3, np.log10(0.99 * c.P_k_max), 100)
+    Pk1 = P(k)
+    P.sigma8 = 0.75
+    Pk2 = P(k)
+    assert_allclose(Pk1.max() / Pk2.max(), (0.82 / 0.75) ** 2,
+                    rtol=1e-2)
+    P.redshift = 0.55
+    Pk3 = P(k)
+    D2 = c.scale_independent_growth_factor(0.)
+    D3 = c.scale_independent_growth_factor(0.55)
+    assert_allclose(Pk2.max() / Pk3.max(), (D2 / D3) ** 2, rtol=1e-2)
+
+
+@pytest.mark.slow
+def test_large_scales_class():
+    """Reference test_power.py::test_large_scales: linear == halofit ==
+    zeldovich on very large scales."""
+    from nbodykit_tpu.cosmology import (LinearPower, HalofitPower,
+                                        ZeldovichPower)
+    c = Cosmology()
+    k = np.logspace(-5, -2, 100)
+    Plin = LinearPower(c, redshift=0)
+    Pnl = HalofitPower(c, redshift=0)
+    Pzel = ZeldovichPower(c, redshift=0)
+    assert_allclose(Plin(k), Pnl(k), rtol=1e-2)
+    assert_allclose(Plin(k), Pzel(k), rtol=1e-2)

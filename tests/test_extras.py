@@ -15,8 +15,8 @@ from nbodykit_tpu.meshtools import SlabIterator
 
 
 def test_fnl_galaxy_power():
-    P0 = FNLGalaxyPower(Planck15, 0.5, b1=2.0, fnl=0.0)
-    P1 = FNLGalaxyPower(Planck15, 0.5, b1=2.0, fnl=50.0)
+    P0 = FNLGalaxyPower(Planck15, 0.5, b1=2.0, fnl=0.0, transfer='EisensteinHu')
+    P1 = FNLGalaxyPower(Planck15, 0.5, b1=2.0, fnl=50.0, transfer='EisensteinHu')
     k = np.array([1e-3, 1e-2, 1e-1])
     # fnl=0: P = b1^2 Plin
     np.testing.assert_allclose(P0(k), 4.0 * P0.linear(k), rtol=1e-10)

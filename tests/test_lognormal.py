@@ -14,7 +14,7 @@ from nbodykit_tpu.parallel.runtime import cpu_mesh, use_mesh
 
 @pytest.fixture(scope='module')
 def plin():
-    P = LinearPower(Planck15, redshift=0.55)
+    P = LinearPower(Planck15, redshift=0.55, transfer='EisensteinHu')
     P.sigma8 = 0.8
     return P
 

@@ -148,7 +148,7 @@ def test_fftrecon_reduces_displacement():
     # reconstruction should partially undo Zel'dovich displacements:
     # the reconstructed field's large-scale power moves toward linear
     from nbodykit_tpu.algorithms.fftrecon import FFTRecon
-    Plin = LinearPower(Planck15, 0.0)
+    Plin = LinearPower(Planck15, 0.0, transfer='EisensteinHu')
     Plin.sigma8 = 0.8
     data = LogNormalCatalog(Plin=Plin, nbar=2e-3, BoxSize=200.,
                             Nmesh=32, bias=1.5, seed=21)
