@@ -1,0 +1,127 @@
+"""ctypes loader for the native Einstein-Boltzmann kernel.
+
+Compiles ``csrc/boltzmann_kernel.cpp`` on demand with g++ (cached by
+source hash under ``~/.cache/nbodykit_tpu``) and exposes
+:func:`solve_mode_native`, a drop-in for
+``BoltzmannSolver.solve_mode``.  Any failure (no compiler, compile
+error, nonzero return code) falls back to the Python BDF path — the
+kernel is an accelerator, not a dependency.
+
+pybind11 is not available in this environment; the plain C ABI +
+ctypes keeps the binding dependency-free (build brief: native runtime
+components with ctypes/cffi bindings).
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    '..', '..', 'csrc', 'boltzmann_kernel.cpp')
+_CACHE = os.environ.get(
+    'NBKIT_TPU_NATIVE_CACHE',
+    os.path.join(os.path.expanduser('~'), '.cache', 'nbodykit_tpu'))
+
+_lib = None
+_lib_err = None
+
+
+def _dp(x):
+    return x.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _build():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    if os.environ.get('NBKIT_TPU_NO_NATIVE'):
+        _lib_err = 'disabled by NBKIT_TPU_NO_NATIVE'
+        return None
+    try:
+        src_path = os.path.abspath(_SRC)
+        with open(src_path, 'rb') as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        os.makedirs(_CACHE, exist_ok=True)
+        so = os.path.join(_CACHE, 'boltzmann_kernel_%s.so' % tag)
+        if not os.path.exists(so):
+            tmp = so + '.tmp.%d' % os.getpid()
+            subprocess.run(
+                ['g++', '-O3', '-shared', '-fPIC', '-std=c++17',
+                 '-o', tmp, src_path],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        lib.nbk_solve_mode.restype = ctypes.c_int
+        _lib = lib
+    except Exception as e:          # noqa: BLE001 - fallback by design
+        _lib_err = str(e)
+        _lib = None
+    return _lib
+
+
+def native_available():
+    return _build() is not None
+
+
+def solve_mode_native(solver, k, lna_out):
+    """Run one k-mode through the C++ kernel; returns the same dict as
+    ``BoltzmannSolver.solve_mode`` or None on any failure."""
+    lib = _build()
+    if lib is None:
+        return None
+    bg = solver.bg
+    ns = len(bg.ncdm)
+    ng = len(solver._g_lnHc)
+
+    lna0 = solver._lna_start(k)
+    x_tc = max(solver._tca_switch_lna(k, lna0), lna0)
+    x_sw = solver._rsa_switch_lna(k, lna0)
+    if not np.isfinite(x_sw) or x_sw <= x_tc or x_sw >= 0.0:
+        x_sw = 1.0            # sentinel: no RSA phase
+    y0 = np.ascontiguousarray(solver._initial(k, lna0))
+
+    lna_out = np.ascontiguousarray(np.asarray(lna_out, dtype='f8'))
+    nout = len(lna_out)
+    out = np.empty((nout, 12))
+    stats = np.zeros(2, dtype=np.int64)
+
+    if ns:
+        lndrho = np.ascontiguousarray(
+            np.stack(solver._g_ncdm_lndrho))
+        wtab = np.ascontiguousarray(np.stack(solver._g_ncdm_w))
+        cg2tab = np.ascontiguousarray(np.stack(solver._g_ncdm_cg2))
+        y0n = np.array([s.y0 for s in bg.ncdm])
+    else:
+        lndrho = wtab = cg2tab = np.zeros((1, ng))
+        y0n = np.zeros(1)
+
+    H02 = bg.H0 ** 2
+    rc = lib.nbk_solve_mode(
+        ctypes.c_double(solver._gx0), ctypes.c_double(solver._gdx),
+        ctypes.c_int(ng),
+        _dp(solver._g_lnHc), _dp(solver._g_lntau),
+        _dp(solver._g_lndk), _dp(solver._g_cs2),
+        ctypes.c_int(ns), _dp(lndrho), _dp(wtab), _dp(cg2tab),
+        ctypes.c_int(solver.nq), _dp(solver._q), _dp(solver._Wq),
+        _dp(solver._dlnf), _dp(y0n),
+        ctypes.c_int(solver.lg), ctypes.c_int(solver.lp),
+        ctypes.c_int(solver.lu), ctypes.c_int(solver.ln),
+        ctypes.c_double(H02 * bg.Omega_g),
+        ctypes.c_double(H02 * bg.Omega_ur),
+        ctypes.c_double(H02 * bg.Omega_b),
+        ctypes.c_double(H02 * bg.Omega_cdm),
+        ctypes.c_double(k), ctypes.c_double(lna0),
+        ctypes.c_double(x_tc), ctypes.c_double(x_sw),
+        _dp(y0), ctypes.c_int(solver.nvar),
+        ctypes.c_double(solver.rtol),
+        ctypes.c_int(nout), _dp(lna_out),
+        _dp(out), stats.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_long)))
+    if rc != 0:
+        return None
+    names = ('phi', 'psi', 'd_cdm', 't_cdm', 'd_b', 't_b',
+             'd_g', 't_g', 'd_ur', 't_ur', 'd_ncdm', 't_ncdm')
+    return {n: out[:, i].copy() for i, n in enumerate(names)}

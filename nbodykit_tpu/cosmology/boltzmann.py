@@ -443,7 +443,8 @@ class BoltzmannSolver(object):
 
     def __init__(self, bg, th, lmax_g=10, lmax_pol=8, lmax_ur=12,
                  nq_ncdm=4, lmax_ncdm=5, rsa_ktau=45.0, rsa_dkappa_tau=0.06,
-                 rtol=3e-6):
+                 rtol=3e-6, use_native=True):
+        self.use_native = bool(use_native)
         self.bg = bg
         self.th = th
         self.lg, self.lp, self.lu, self.ln = lmax_g, lmax_pol, lmax_ur, \
@@ -925,7 +926,19 @@ class BoltzmannSolver(object):
 
     def solve_mode(self, k, lna_out):
         """Integrate one k-mode (k in 1/Mpc); return dict of outputs on
-        lna_out (must be increasing, ending at 0 = today)."""
+        lna_out (must be increasing, ending at 0 = today).
+
+        Uses the native C++ kernel (csrc/boltzmann_kernel.cpp) when it
+        compiles, falling back to the scipy BDF path below; the two are
+        cross-checked in tests/test_boltzmann_native.py."""
+        if self.use_native:
+            from . import _native
+            out = _native.solve_mode_native(self, float(k), lna_out)
+            if out is not None:
+                return out
+        return self._solve_mode_py(k, lna_out)
+
+    def _solve_mode_py(self, k, lna_out):
         lna0 = self._lna_start(k)
         y0_full = self._initial(k, lna0)
         x_tc = max(self._tca_switch_lna(k, lna0), lna0)

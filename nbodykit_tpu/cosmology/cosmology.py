@@ -234,8 +234,11 @@ class Cosmology(object):
 
         # dark energy bookkeeping (reference: Omega_Lambda vs fld,
         # cosmology.py 'Non-cosmological constant dark energy...')
+        # "fld mode" means the fld component actually carries dark
+        # energy: an explicit Omega0_fld=0.0 (e.g. from a dict(c)
+        # round-trip of an LCDM cosmology) must NOT count
         w_mode = (d['w0_fld'] != -1.0 or d['wa_fld'] != 0.0
-                  or d.get('Omega0_fld') is not None)
+                  or bool(d.get('Omega0_fld')))
         if w_mode and d.get('Omega0_lambda') not in (None, 0.0, 0):
             raise ValueError("specifying w0_fld/wa_fld together with "
                              "Omega0_lambda is inconsistent; use "
@@ -506,7 +509,7 @@ class Cosmology(object):
         """dE/da (the reference classylss convention)."""
         z = np.asarray(z, dtype='f8')
         a = 1.0 / (1.0 + z)
-        eps = 1e-5
+        eps = 1e-5 * a               # relative step: safe at any z
         return (np.sqrt(self._bg.E2(a + eps))
                 - np.sqrt(self._bg.E2(a - eps))) / (2 * eps)
 
@@ -958,14 +961,7 @@ class Cosmology(object):
                 key, _, val = line.partition('=')
                 key = key.strip()
                 val = val.strip()
-                try:
-                    v = float(val)
-                    if v == int(v) and '.' not in val and 'e' not in \
-                            val.lower():
-                        v = int(v)
-                except ValueError:
-                    v = val
-                pars[key] = v
+                pars[key] = _parse_ini_value(val)
         pars.update(kwargs)
         return cls(**pars)
 
@@ -1062,10 +1058,12 @@ class Cosmology(object):
             args['w0_fld'] = cosmo.w0
             args['wa_fld'] = cosmo.wa
             args['Omega0_Lambda'] = 0.0
+            args['Omega0_fld'] = cosmo.Ode0   # explicit: works at w0=-1
         elif isinstance(cosmo, (acosmo.wCDM, acosmo.FlatwCDM)):
             args['w0_fld'] = cosmo.w0
             args['wa_fld'] = 0.0
             args['Omega0_Lambda'] = 0.0
+            args['Omega0_fld'] = cosmo.Ode0
         elif isinstance(cosmo, (acosmo.LambdaCDM,
                                 acosmo.FlatLambdaCDM)):
             pass
@@ -1081,6 +1079,27 @@ class Cosmology(object):
         return ("Cosmology(h=%.4g, Omega0_m=%.4g, Omega0_b=%.4g, "
                 "n_s=%.4g)" % (self.h, self.Omega0_m, self.Omega0_b,
                                self.n_s))
+
+
+def _parse_ini_value(val):
+    """Parse one CLASS-ini value: bool, number, comma list, or str."""
+    low = val.lower()
+    if low in ('true', 'yes'):
+        return True
+    if low in ('false', 'no'):
+        return False
+    if ',' in val:
+        try:
+            return [float(x) for x in val.split(',') if x.strip()]
+        except ValueError:
+            return val
+    try:
+        v = float(val)
+        if v == int(v) and '.' not in val and 'e' not in low:
+            v = int(v)
+        return v
+    except ValueError:
+        return val
 
 
 def _cosmology_unpickle(pars):
