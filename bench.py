@@ -89,16 +89,19 @@ def _bench_fftpower_fn(pm, Npart, resampler='cic', slab_chunks=16):
     import jax
     import jax.numpy as jnp
     from nbodykit_tpu.ops.window import compensation_transfer
+    from nbodykit_tpu.ops.histogram import hist2d_mxu
 
     Nmesh = int(pm.Nmesh[0])
     L = float(pm.BoxSize[0])
-    kedges = np.arange(0.0, np.pi * Nmesh / L + np.pi / (L / 2.0),
-                       2 * np.pi / L)
-    Nx = len(kedges) - 1
+    # kedges at integer multiples of the fundamental 2*pi/L (the
+    # reference's dk default): binning runs on INTEGER lattice norms
+    # (isq = ix^2+iy^2+iz^2 vs edge m^2), which is exact — float
+    # digitize puts on-edge lattice modes (any isq that is a perfect
+    # square) on a rounding-dependent side
+    Nx = Nmesh // 2
     Nmu = 10
-    nbins = (Nx + 2) * (Nmu + 2)
-    x2edges = jnp.asarray(kedges.astype('f4') ** 2)
-    muedges = jnp.asarray(np.linspace(-1, 1, Nmu + 1).astype('f4'))
+    isq_edges = jnp.asarray((np.arange(Nx + 1, dtype='i8') ** 2)
+                            .astype('i4'))
     transfer = compensation_transfer(resampler, False)
     V = L ** 3
 
@@ -106,9 +109,11 @@ def _bench_fftpower_fn(pm, Npart, resampler='cic', slab_chunks=16):
     assert N1c % slab_chunks == 0
     rows = N1c // slab_chunks
 
-    kx_full, ky_full, kz_full = pm.k_list(dtype=jnp.float32)
-    # ky is the leading axis of the transposed layout
-    ky_flat = ky_full.reshape(-1)
+    # integer lattice coordinates in the transposed layout
+    iy_flat = jnp.asarray(np.fft.fftfreq(N1c, d=1.0 / N1c).astype('i4'))
+    ix_full = jnp.asarray(np.fft.fftfreq(N0c, d=1.0 / N0c)
+                          .astype('i4')).reshape(1, N0c, 1)
+    iz_full = jnp.asarray(np.arange(nz, dtype='i4')).reshape(1, 1, nz)
 
     def fftpower(pos):
         n = pos.shape[0]
@@ -125,22 +130,32 @@ def _bench_fftpower_fn(pm, Npart, resampler='cic', slab_chunks=16):
             Psum, Nsum = acc
             sl = jax.lax.dynamic_slice(p3, (i * rows, 0, 0),
                                        (rows, N0c, nz))
-            ky = jax.lax.dynamic_slice(ky_flat, (i * rows,),
+            iy = jax.lax.dynamic_slice(iy_flat, (i * rows,),
                                        (rows,)).reshape(rows, 1, 1)
-            k2 = kx_full * kx_full + ky * ky + kz_full * kz_full
-            kk = jnp.sqrt(k2)
-            mu = jnp.where(kk == 0, 0.0,
-                           kz_full / jnp.where(kk == 0, 1.0, kk))
+            isq = (ix_full * ix_full + iy * iy + iz_full * iz_full)
             wgt = jnp.broadcast_to(herm_z, sl.shape).reshape(-1)
-            dig = (jnp.digitize(k2.reshape(-1), x2edges) * (Nmu + 2)
-                   + jnp.digitize(jnp.broadcast_to(mu, sl.shape)
-                                  .reshape(-1), muedges)).astype(jnp.int32)
-            Psum = Psum + jnp.bincount(dig, weights=sl.reshape(-1) * wgt,
-                                       length=nbins)
-            Nsum = Nsum + jnp.bincount(dig, weights=wgt, length=nbins)
-            return Psum, Nsum
+            dig_k = jnp.searchsorted(
+                isq_edges, jnp.broadcast_to(isq, sl.shape).reshape(-1),
+                side='right')
+            # exact integer mu binning (edges m/5, m=-5..5; mu >= 0 on
+            # the half-spectrum): mu >= m/5  <=>  25*iz^2 >= m^2*isq.
+            # Float mu is rounding-ambiguous exactly on the Pythagorean
+            # lattice ratios (3/5, 4/5, 1) the edges hit.
+            izsq25 = 25 * iz_full * iz_full
+            dig_mu = sum((izsq25 >= (m * m) * isq).astype(jnp.int32)
+                         for m in range(1, Nmu // 2 + 1))
+            dig_mu = jnp.where(isq == 0, 0, dig_mu) + (Nmu // 2 + 1)
+            dig_mu = jnp.broadcast_to(dig_mu, sl.shape).reshape(-1)
+            # MXU one-hot-matmul histogram: ~5x faster than
+            # scatter-add bincount on TPU (see ops/histogram.py)
+            P_c, N_c = hist2d_mxu(dig_k, dig_mu,
+                                  [sl.reshape(-1) * wgt, wgt],
+                                  Nx + 2, Nmu + 2,
+                                  acc_dtype=jnp.float32)
+            return Psum + P_c, Nsum + N_c
 
-        init = (jnp.zeros(nbins, jnp.float32), jnp.zeros(nbins, jnp.float32))
+        init = (jnp.zeros((Nx + 2, Nmu + 2), jnp.float32),
+                jnp.zeros((Nx + 2, Nmu + 2), jnp.float32))
         return jax.lax.fori_loop(0, slab_chunks, body, init)
 
     return fftpower
@@ -149,8 +164,21 @@ def _bench_fftpower_fn(pm, Npart, resampler='cic', slab_chunks=16):
 def _make_pos(jax, jnp, Npart, L, seed=7):
     pos = jax.random.uniform(jax.random.key(seed), (Npart, 3),
                              jnp.float32, 0.0, L)
-    jax.block_until_ready(pos)
+    _sync(jax, pos)
     return pos
+
+
+def _sync(jax, out):
+    """Force completion by transferring one scalar to the host.
+
+    ``jax.block_until_ready`` does NOT reliably wait under the axon
+    tunnel (async relay) — round-2 measurements with it reported a
+    1e7-particle paint at 0.1 ms. A scalar device->host transfer is an
+    actual synchronization point.
+    """
+    import jax.numpy as jnp
+    leaf = jax.tree.leaves(out)[0]
+    return float(jnp.asarray(leaf).ravel()[0])
 
 
 def cmd_config(Nmesh, Npart, method='scatter', reps=3):
@@ -164,13 +192,12 @@ def cmd_config(Nmesh, Npart, method='scatter', reps=3):
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fn = jax.jit(_bench_fftpower_fn(pm, Npart))
     t0 = time.time()
-    out = fn(pos)
-    jax.block_until_ready(out)
+    _sync(jax, fn(pos))
     compile_s = time.time() - t0
     t0 = time.time()
     for _ in range(reps):
         out = fn(pos)
-    jax.block_until_ready(out)
+        _sync(jax, out)
     dt = (time.time() - t0) / reps
     print(json.dumps({
         "metric": "fftpower_wallclock_nmesh%d_npart%.0e" % (Nmesh, Npart),
@@ -194,11 +221,11 @@ def cmd_paint(Nmesh, Npart, method='scatter', reps=3):
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic'))
-    jax.block_until_ready(fn(pos))
+    _sync(jax, fn(pos))
     t0 = time.time()
     for _ in range(reps):
         out = fn(pos)
-    jax.block_until_ready(out)
+        _sync(jax, out)
     dt = (time.time() - t0) / reps
     print(json.dumps({
         "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"
@@ -222,11 +249,11 @@ def cmd_autotune(Nmesh, Npart):
         try:
             with nbodykit_tpu.set_options(paint_method=method):
                 f = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic'))
-                jax.block_until_ready(f(pos))
+                _sync(jax, f(pos))
                 t0 = time.time()
                 for _ in range(2):
                     out = f(pos)
-                jax.block_until_ready(out)
+                    _sync(jax, out)
                 times[method] = (time.time() - t0) / 2
         except Exception as e:
             print("paint method %s failed: %s" % (method, str(e)[:120]),
@@ -290,11 +317,12 @@ def main():
         return 1
     print("[bench] backend: %s" % probe, file=sys.stderr)
 
-    tune = _run_sub(['--autotune', '256', '2000000'], min(420, left()))
-    detail['autotune'] = tune
-    method = (tune or {}).get('best', 'scatter')
-    print("[bench] paint method: %s (%s)" % (method, tune),
-          file=sys.stderr)
+    # Paint kernel: 'scatter' — measured (with real scalar-transfer
+    # sync) at 256^3/1e6 the sort kernel is ~100x slower on v5e, so
+    # autotuning it at scale just burns budget and risks a timeout-kill
+    # (which wedges the axon tunnel for every later subprocess). The
+    # --autotune subcommand remains for manual kernel comparisons.
+    method = 'scatter'
 
     # paint microbench at a mid scale
     if left() > 240:
@@ -304,12 +332,7 @@ def main():
         print("[bench] paint micro: %s" % p, file=sys.stderr)
 
     # smallest-first ladder up to the north-star config; keep the last
-    # success. The paint kernel is re-autotuned at each Nmesh scale (a
-    # small-probe winner must not be forced on large configs — the sort
-    # kernel's memory/cost profile changes with Nmesh/Npart), and a
-    # failed config is retried once with the other kernel before
-    # stopping escalation (on axon, a huge failed compile can wedge the
-    # tunnel for everyone downstream).
+    # success.
     ladder = [
         (128, 100_000, 120),
         (256, 1_000_000, 180),
@@ -318,31 +341,13 @@ def main():
         (1024, 100_000_000, 700),
     ]
     best = None
-    tuned_at = 256
     for Nmesh, Npart, budget in ladder:
         if left() < budget * 0.5:
             print("[bench] skipping Nmesh=%d Npart=%d (%.0fs left)"
                   % (Nmesh, Npart, left()), file=sys.stderr)
             break
-        if Nmesh > tuned_at and left() > budget:
-            t = _run_sub(['--autotune', str(Nmesh),
-                          str(min(Npart, 5_000_000))],
-                         min(420, left() - budget * 0.5))
-            if t is not None:
-                method = t.get('best', method)
-                tuned_at = Nmesh
-                print("[bench] re-autotuned at Nmesh=%d: %s"
-                      % (Nmesh, t), file=sys.stderr)
         res = _run_sub(['--config', str(Nmesh), str(Npart), method],
                        min(budget, left()))
-        if res is None:
-            other = 'sort' if method == 'scatter' else 'scatter'
-            print("[bench] config Nmesh=%d Npart=%d failed with %s; "
-                  "retrying with %s" % (Nmesh, Npart, method, other),
-                  file=sys.stderr)
-            if left() > budget * 0.5:
-                res = _run_sub(['--config', str(Nmesh), str(Npart),
-                                other], min(budget, left()))
         detail['configs'].append(res)
         if res is None:
             print("[bench] config Nmesh=%d Npart=%d failed; stopping "

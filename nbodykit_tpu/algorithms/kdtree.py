@@ -1,10 +1,15 @@
 """KDDensity: a fast per-particle density proxy.
 
 Reference: ``nbodykit/algorithms/kdtree.py:9`` — crude density from
-nearest-neighbor distances (scipy cKDTree + domain ghosts there).
-TPU redesign: neighbor *counts* within a kernel radius via the same
+nearest-neighbor distances (scipy cKDTree + domain ghosts there;
+GridND decompose at nbodykit/algorithms/kdtree.py:70-90). TPU
+redesign: neighbor *counts* within a kernel radius via the same
 grid-hash sweep as FOF/pair counting, fully vectorized; the density
-proxy is count / kernel volume.
+proxy is count / kernel volume. With a device mesh active the sweep
+runs domain-decomposed: particles route to x-slab owners with
+both-side ghost copies within the kernel radius, each device sweeps
+its slab in-graph, and per-particle counts route back to the global
+order — no device ever holds the full particle set.
 """
 
 import logging
@@ -14,6 +19,57 @@ import jax
 import jax.numpy as jnp
 
 from ..utils import as_numpy
+
+
+def _kdd_counts_dist(pos, box, r, mesh, periodic=True):
+    """Per-particle neighbor counts within ``r``, domain-decomposed.
+
+    pos : (N, 3) global sharded positions; box : (3,) floats;
+    r : kernel radius. Returns (N,) f4 counts (self included), as a
+    global sharded array in input order.
+    """
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.runtime import AXIS, shard_leading
+    from ..parallel.domain import slab_route, scatter_reduce_by_index
+    from ..ops.devicehash import DeviceGridHash
+
+    N = int(pos.shape[0])
+    box = np.asarray(box, dtype='f8')
+    route, f, live = slab_route(pos, box, r, mesh, ghosts='both',
+                                periodic=periodic)
+    gid = shard_leading(mesh, jnp.arange(N, dtype=jnp.int32))
+    own = jnp.concatenate(
+        [jnp.ones(N, bool)] + [jnp.zeros(N, bool)] * (f - 1))
+    pos_f = jnp.concatenate([pos] * f)
+    gid_f = jnp.concatenate([gid] * f)
+    (pos_r, gid_r, own_r, live_r), ok, _ = route.exchange(
+        [pos_f, gid_f, own, live])
+    valid = ok & live_r
+    r2 = float(r) ** 2
+
+    def local(p, v, own_l):
+        grid = DeviceGridHash(p, box, r, valid=v, periodic=periodic,
+                              axis_name=AXIS)
+        ci = grid.cell_of(grid.pos_s)
+        own_s = own_l[grid.order] & grid.valid_s
+
+        def body(total, j, okc, d, rr2):
+            hit = okc & own_s & (rr2 <= r2)
+            return total + jnp.where(hit, 1.0, 0.0)
+
+        counts_s = grid.fold(grid.pos_s, ci, body,
+                             jnp.zeros(p.shape[0], jnp.float32))
+        # back to slot order
+        return jnp.zeros(p.shape[0], jnp.float32).at[grid.order].set(
+            counts_s)
+
+    counts_r = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS), P(AXIS)),
+        out_specs=P(AXIS)))(pos_r, valid, own_r)
+    own_live = own_r & valid
+    out = scatter_reduce_by_index(gid_r, counts_r, N, mesh, op='add',
+                                  valid=own_live)
+    return out[:N]
 
 
 class KDDensity(object):
@@ -41,12 +97,22 @@ class KDDensity(object):
                                           dtype='f8')
         self.attrs = dict(margin=margin, BoxSize=BoxSize)
 
-        pos = as_numpy(source['Position'])
-        N = len(pos)
+        N = source.csize
         mean_sep = (np.prod(BoxSize) / N) ** (1.0 / 3)
         r = margin * mean_sep
         self.attrs['kernel_radius'] = r
+        vol = 4.0 / 3 * np.pi * r ** 3
 
+        from ..parallel.runtime import mesh_size
+        nproc = mesh_size(self.comm)
+        if nproc > 1 and r <= BoxSize[0] / nproc:
+            pos = jnp.asarray(source['Position'])
+            counts = _kdd_counts_dist(pos, BoxSize, r, self.comm,
+                                      periodic=True)
+            self.density = counts / vol
+            return
+
+        pos = as_numpy(source['Position'])
         from ..ops.gridhash import GridHash
         grid = GridHash(pos, BoxSize, r, periodic=True)
         r2 = r * r
@@ -59,5 +125,4 @@ class KDDensity(object):
             return grid.fold(p, ci, body, jnp.zeros(p.shape[0]))
 
         counts_per = neighbor_counts(jnp.asarray(pos))
-        vol = 4.0 / 3 * np.pi * r ** 3
         self.density = counts_per / vol

@@ -14,6 +14,14 @@ sympy (YlmCache, :393); here the jnp real harmonics of
 :func:`..convpower.fkp.get_real_Ylm` are reused, so the whole neighbor
 sweep + moment accumulation + (b1, b2) outer product runs as one jitted
 program (the outer product lands on the MXU).
+
+With a device mesh active the sweep runs domain-decomposed (the
+reference decomposes with ``smoothing=rmax`` ghosts through the pair-
+counting machinery, threeptcf.py:6,60): particles route to x-slab
+owners with both-side ghost copies within rmax, every device
+accumulates a_lm moments for its *owned* primaries against its local
+(owned + ghost) secondaries, and the per-ell zeta matrices are
+psum-reduced — no device ever holds the full particle set.
 """
 
 import logging
@@ -26,6 +34,55 @@ from .convpower.fkp import get_real_Ylm
 from ..binned_statistic import BinnedStatistic
 from ..utils import as_numpy
 from .. import transform
+
+
+def _se_chunk_zeta(grid, w_s, ylms, nbins, r2edges):
+    """The per-chunk Slepian–Eisenstein accumulation shared by the
+    single-device and distributed drivers: a_lm(r-bin) moments of each
+    primary's neighbors (via ``grid.fold``), then the per-ell
+    (b1, b2) outer product zeta_l = (4pi/(2l+1)) sum_m a_lm a_lm^T.
+
+    ``grid`` is a GridHash or DeviceGridHash whose sorted weights are
+    ``w_s``; the returned callable maps (positions, weights, live-mask)
+    chunks to a stacked (nell, nbins, nbins) zeta contribution.
+    """
+    nlm = sum(2 * ell + 1 for ell, _ in ylms)
+    pvary = getattr(grid, 'pvary', lambda x: x)
+
+    def chunk_zeta(args):
+        p1c, w1c, live = args
+        C = p1c.shape[0]
+        ci = grid.cell_of(p1c)
+        alm0 = pvary(jnp.zeros((C, nlm, nbins)))
+
+        def body(alm, j, valid, d, r2):
+            ok = valid & live & (r2 > 1e-20)
+            rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
+            u = d / rr[:, None]
+            dig = jnp.digitize(r2, r2edges) - 1
+            inb = ok & (dig >= 0) & (dig < nbins)
+            digc = jnp.clip(dig, 0, nbins - 1)
+            wj = jnp.where(inb, w_s[j], 0.0)
+            onehot = jax.nn.one_hot(digc, nbins) * wj[:, None]
+            yvs = []
+            for ell, Ys in ylms:
+                for Y in Ys:
+                    yvs.append(Y(u[:, 0], u[:, 1], u[:, 2]))
+            yv = jnp.stack(yvs, axis=1)  # (C, nlm)
+            return alm + yv[:, :, None] * onehot[:, None, :]
+
+        alm = grid.fold(p1c, ci, body, alm0)
+        outs = []
+        ilm = 0
+        for ell, Ys in ylms:
+            nm = 2 * ell + 1
+            a = alm[:, ilm:ilm + nm, :]  # (C, nm, nbins)
+            z = jnp.einsum('i,imb,imc->bc', w1c, a, a)
+            outs.append(z * (4 * np.pi / nm))
+            ilm += nm
+        return jnp.stack(outs)
+
+    return chunk_zeta
 
 
 class Base3PCF(object):
@@ -55,43 +112,7 @@ class Base3PCF(object):
         ells = sorted(poles)
         ylms = [(ell, [get_real_Ylm(ell, m)
                        for m in range(-ell, ell + 1)]) for ell in ells]
-
-        def chunk_zeta(args):
-            p1c, w1c, live = args
-            C = p1c.shape[0]
-            ci = grid.cell_of(p1c)
-            # a_lm moments per (primary, lm, bin)
-            nlm = sum(2 * ell + 1 for ell in ells)
-            alm0 = jnp.zeros((C, nlm, nbins))
-
-            def body(alm, j, valid, d, r2):
-                ok = valid & live & (r2 > 1e-20)
-                rr = jnp.sqrt(jnp.where(r2 == 0, 1.0, r2))
-                u = d / rr[:, None]
-                dig = jnp.digitize(r2, r2edges) - 1
-                inb = ok & (dig >= 0) & (dig < nbins)
-                digc = jnp.clip(dig, 0, nbins - 1)
-                wj = jnp.where(inb, w_s[j], 0.0)
-                onehot = jax.nn.one_hot(digc, nbins) \
-                    * wj[:, None]  # (C, nbins)
-                yvs = []
-                for ell, Ys in ylms:
-                    for Y in Ys:
-                        yvs.append(Y(u[:, 0], u[:, 1], u[:, 2]))
-                yv = jnp.stack(yvs, axis=1)  # (C, nlm)
-                return alm + yv[:, :, None] * onehot[:, None, :]
-
-            alm = grid.fold(p1c, ci, body, alm0)
-            # zeta_l(b1,b2) = sum_i w_i (4pi/(2l+1)) sum_m alm alm^T
-            outs = []
-            ilm = 0
-            for ell, Ys in ylms:
-                nm = 2 * ell + 1
-                a = alm[:, ilm:ilm + nm, :]  # (C, nm, nbins)
-                z = jnp.einsum('i,imb,imc->bc', w1c, a, a)
-                outs.append(z * (4 * np.pi / nm))
-                ilm += nm
-            return jnp.stack(outs)
+        chunk_zeta = _se_chunk_zeta(grid, w_s, ylms, nbins, r2edges)
 
         chunk = 2048
         nchunks = max(1, (N + chunk - 1) // chunk)
@@ -105,7 +126,78 @@ class Base3PCF(object):
                            jnp.asarray(w1).reshape(nchunks, chunk),
                            jnp.asarray(live).reshape(nchunks, chunk)))
         zetas = np.array(res.sum(axis=0))  # (nell, nbins, nbins)
+        return self._package(zetas, edges, sorted(poles))
 
+    def _run_dist(self, pos, w, edges, poles, mesh, BoxSize=None,
+                  periodic=True):
+        """Device-mesh SE sweep: sharded positions in, psum'd zetas
+        out. Mirrors :meth:`_run` slab-decomposed (ghosts='both')."""
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.runtime import AXIS, shard_leading
+        from ..parallel.domain import slab_route
+        from ..ops.devicehash import DeviceGridHash
+
+        edges = np.asarray(edges, dtype='f8')
+        nbins = len(edges) - 1
+        rmax = float(edges[-1])
+        N = int(pos.shape[0])
+
+        if BoxSize is None:
+            lo = np.asarray(jnp.min(pos, axis=0))
+            hi = np.asarray(jnp.max(pos, axis=0))
+            box = (hi - lo) * 1.001 + 1e-3
+            origin = jnp.asarray(lo, pos.dtype)
+            periodic = False
+        else:
+            box = np.ones(3) * np.asarray(BoxSize, dtype='f8')
+            origin = jnp.zeros(3, pos.dtype)
+
+        pos = pos - origin
+        route, f, live = slab_route(pos, box, rmax, mesh,
+                                    ghosts='both', periodic=periodic)
+        own = jnp.concatenate(
+            [jnp.ones(N, bool)] + [jnp.zeros(N, bool)] * (f - 1))
+        w = jnp.asarray(w)
+        (pos_r, w_r, own_r, live_r), ok, _ = route.exchange(
+            [jnp.concatenate([pos] * f), jnp.concatenate([w] * f),
+             own, live])
+        valid = ok & live_r
+
+        ells = sorted(poles)
+        ylms = [(ell, [get_real_Ylm(ell, m)
+                       for m in range(-ell, ell + 1)]) for ell in ells]
+        r2edges = jnp.asarray(edges ** 2)
+        chunk = 2048
+
+        def local(p, wv, v, own_l):
+            grid = DeviceGridHash(p, box, rmax, valid=v,
+                                  periodic=periodic, axis_name=AXIS)
+            w_s = wv[grid.order]
+            S = p.shape[0]
+            nchunks = max(1, (S + chunk - 1) // chunk)
+            npad = nchunks * chunk
+            pad = npad - S
+            p1 = jnp.concatenate([p, jnp.zeros((pad, 3), p.dtype)])
+            w1 = jnp.concatenate([wv, jnp.zeros(pad, wv.dtype)])
+            prim = jnp.concatenate([own_l & v, jnp.zeros(pad, bool)])
+            chunk_zeta = _se_chunk_zeta(grid, w_s, ylms, nbins,
+                                        r2edges)
+
+            res = jax.lax.map(
+                chunk_zeta,
+                (p1.reshape(nchunks, chunk, 3),
+                 w1.reshape(nchunks, chunk),
+                 prim.reshape(nchunks, chunk)))
+            return jax.lax.psum(res.sum(axis=0), AXIS)
+
+        zetas = np.array(jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS)),
+            out_specs=P()))(pos_r, w_r, valid, own_r))
+        return self._package(zetas, edges, ells)
+
+    def _package(self, zetas, edges, ells):
+        nbins = len(edges) - 1
         data = {}
         centers = 0.5 * (edges[1:] + edges[:-1])
         data['r1'] = np.broadcast_to(centers[:, None],
@@ -140,6 +232,17 @@ class SimulationBox3PCF(Base3PCF):
                           edges=np.asarray(edges, 'f8'),
                           BoxSize=np.ones(3) * np.asarray(BoxSize),
                           periodic=periodic)
+        from ..parallel.runtime import mesh_size
+        nproc = mesh_size(self.comm)
+        box = self.attrs['BoxSize']
+        if nproc > 1 and np.max(edges) <= box[0] / nproc:
+            pos = jnp.asarray(source[position])
+            w = jnp.asarray(source[weight]) if weight in source else \
+                jnp.ones(pos.shape[0])
+            self.poles = self._run_dist(pos, w, edges, poles,
+                                        self.comm, BoxSize=box,
+                                        periodic=periodic)
+            return
         pos = as_numpy(source[position])
         w = as_numpy(source[weight]) if weight in source else \
             np.ones(len(pos))
@@ -159,8 +262,21 @@ class SurveyData3PCF(Base3PCF):
         self.comm = source.comm
         self.attrs = dict(poles=list(poles),
                           edges=np.asarray(edges, 'f8'))
-        pos = as_numpy(transform.SkyToCartesian(
+        from ..parallel.runtime import mesh_size
+        nproc = mesh_size(self.comm)
+        posj = jnp.asarray(transform.SkyToCartesian(
             source[ra], source[dec], source[redshift], cosmo))
+        if nproc > 1:
+            span = np.asarray(jnp.max(posj, axis=0)
+                              - jnp.min(posj, axis=0)) * 1.001 + 1e-3
+            if np.max(edges) <= span[0] / nproc:
+                w = jnp.asarray(source[weight]) if weight in source \
+                    else jnp.ones(posj.shape[0])
+                self.poles = self._run_dist(
+                    posj, w, edges, poles, self.comm, BoxSize=None,
+                    periodic=False)
+                return
+        pos = as_numpy(posj)
         w = as_numpy(source[weight]) if weight in source else \
             np.ones(len(pos))
         self.poles = self._run(pos, w, edges, poles, BoxSize=None,

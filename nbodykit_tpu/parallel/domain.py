@@ -124,6 +124,11 @@ def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True):
         live = jnp.concatenate([jnp.ones(n, bool), lo_margin])
         return Route(dest, mesh), 2, live
     if ghosts == 'both':
+        if nproc == 2 and periodic:
+            # the lower and upper neighbor are the SAME device: a
+            # particle within rmax of both faces must ship only one
+            # live ghost copy, or neighbor sweeps double-count it
+            hi_margin = hi_margin & ~lo_margin
         dest = jnp.concatenate([owner,
                                 jnp.where(lo_margin, lo_dest, owner),
                                 jnp.where(hi_margin, hi_dest, owner)])

@@ -356,3 +356,64 @@ def test_current_mesh_inherited_by_threads(cpu8):
         with ThreadPoolExecutor(1) as ex:
             got = ex.submit(CurrentMesh.get).result()
     assert got is cpu8
+
+
+def test_kddensity_distributed_matches_single(cpu8):
+    """KDDensity on a sharded catalog must reproduce the single-device
+    neighbor counts exactly (device-count invariance, the reference
+    CI discipline; distributed path = slab ghosts + in-graph sweep)."""
+    from nbodykit_tpu.algorithms.kdtree import KDDensity
+    box = 50.0
+    pos = clustered_positions(4096, box, nblob=20, sigma=0.6, seed=11)
+    cat1 = ArrayCatalog({'Position': pos}, BoxSize=box, comm=None)
+    kd1 = KDDensity(cat1, margin=1.0)
+    with use_mesh(cpu8):
+        cat = ArrayCatalog({'Position': pos}, BoxSize=box)
+        kd = KDDensity(cat, margin=1.0)
+    np.testing.assert_allclose(np.asarray(kd.density),
+                               np.asarray(kd1.density), rtol=1e-6)
+
+
+def test_3pcf_distributed_matches_single(cpu8):
+    """SimulationBox3PCF on a sharded catalog: the psum'd SE zeta
+    matrices must match the single-device sweep."""
+    from nbodykit_tpu.algorithms.threeptcf import SimulationBox3PCF
+    box = 40.0
+    rng = np.random.RandomState(21)
+    pos = rng.uniform(0, box, (800, 3))
+    w = rng.uniform(0.5, 1.5, 800)
+    edges = np.array([0.5, 2.0, 4.0])
+    cat1 = ArrayCatalog({'Position': pos, 'Weight': w}, BoxSize=box,
+                        comm=None)
+    r1 = SimulationBox3PCF(cat1, poles=[0, 2], edges=edges)
+    with use_mesh(cpu8):
+        cat = ArrayCatalog({'Position': pos, 'Weight': w}, BoxSize=box)
+        rd = SimulationBox3PCF(cat, poles=[0, 2], edges=edges)
+    for ell in (0, 2):
+        np.testing.assert_allclose(
+            np.asarray(rd.poles['corr_%d' % ell]),
+            np.asarray(r1.poles['corr_%d' % ell]), rtol=1e-8)
+
+
+def test_kddensity_two_device_wraparound_ghosts(cpu8):
+    """nproc=2 periodic: the lower and upper slab neighbor are the SAME
+    device, so a particle within r of both faces must ghost only once
+    (double-counted secondaries inflate the density proxy)."""
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+    from nbodykit_tpu.algorithms.kdtree import KDDensity
+    mesh2 = cpu_mesh(2)
+    box = 10.0
+    rng = np.random.RandomState(31)
+    # concentrate particles in the face margins so wraparound ghosts
+    # dominate: x in [0, 1) and [9, 10) with r ~ 1.08
+    x = np.concatenate([rng.uniform(0, 1.0, 300),
+                        rng.uniform(9.0, 10.0, 300)])
+    pos = np.stack([x, rng.uniform(0, box, 600),
+                    rng.uniform(0, box, 600)], axis=1)
+    cat1 = ArrayCatalog({'Position': pos}, BoxSize=box, comm=None)
+    kd1 = KDDensity(cat1, margin=0.5)
+    with use_mesh(mesh2):
+        cat = ArrayCatalog({'Position': pos}, BoxSize=box)
+        kd = KDDensity(cat, margin=0.5)
+    np.testing.assert_allclose(np.asarray(kd.density),
+                               np.asarray(kd1.density), rtol=1e-6)
