@@ -4,20 +4,153 @@ Reference: ``nbodykit/algorithms/cgm.py:12`` — the Okumura et al. 2017
 cylindrical grouping method: objects are ranked (e.g. by mass); in rank
 order, an object becomes a *central* if no higher-ranked central lies
 within a cylinder of radius ``rperp`` and half-height ``rpar`` around
-it (along the line of sight), else it is a *satellite* of the closest
+it (along the line of sight), else it is a *satellite* of the nearest
 such central.
 
-Implementation: candidate neighbors come from the grid-hash pair
-machinery; the rank-ordered sweep is a host loop (greedy by
-construction, like the reference's sequential pass).
+TPU redesign: the reference resolves the rank order with a sequential
+sweep over mpsort-sorted chunks (cgm.py:150+). The greedy recursion is
+a fixpoint on the rank DAG — ``satellite(i) iff exists j in
+cylinder(i) with rank(j) < rank(i) and not satellite(j)`` — so Jacobi
+iteration of a vectorized cylinder sweep (grid-hash fold, one jitted
+program per round) converges to the identical classification in
+depth-of-the-DAG rounds. With a device mesh active the same rounds run
+domain-decomposed: particles route to x-slab owners with both-side
+ghost copies within sqrt(rperp^2+rpar^2), each round re-ships the
+central flags along the frozen exchange plan, and per-owner verdicts
+scatter back to the global table — no device ever holds the full
+catalog (the role mpsort + chunked kdcount play in the reference).
 """
 
 import logging
 
 import numpy as np
+import jax
+import jax.numpy as jnp
 
 from ..source.catalog.array import ArrayCatalog
 from ..utils import as_numpy
+
+
+def _cylinder_sweep(grid, rank_s, central_s, los, rperp, rpar):
+    """One Jacobi round on sorted slots: per query, the nearest
+    higher-ranked current-central within the cylinder (slot index, or
+    -1)."""
+    ci = grid.cell_of(grid.pos_s)
+    rp2 = jnp.asarray(float(rperp) ** 2, grid.pos_s.dtype)
+    rpar_j = jnp.asarray(float(rpar), grid.pos_s.dtype)
+    los_j = jnp.asarray(los, grid.pos_s.dtype)
+    n = grid.pos_s.shape[0]
+
+    def body(carry, j, valid, d, r2):
+        bestr, bestj = carry
+        dpar = jnp.abs(d @ los_j)
+        dperp2 = jnp.maximum(r2 - dpar * dpar, 0.0)
+        ok = (valid & central_s[j] & (rank_s[j] < rank_s)
+              & (dpar <= rpar_j) & (dperp2 <= rp2))
+        better = ok & (r2 < bestr)
+        return (jnp.where(better, r2, bestr),
+                jnp.where(better, j, bestj))
+
+    init = (jnp.full(n, jnp.inf, grid.pos_s.dtype),
+            jnp.full(n, -1, jnp.int32))
+    _, bestj = grid.fold(grid.pos_s, ci, body, init)
+    return bestj
+
+
+def _cgm_classify(pos, rank, box, rperp, rpar, los, periodic, mesh):
+    """(satellite mask, haloid) in original order; haloid = -1 for
+    non-satellites. ``rank``: i4, 0 = highest priority."""
+    from ..ops.devicehash import DeviceGridHash
+    from ..parallel.runtime import AXIS, mesh_size, shard_leading
+    from ..parallel.domain import slab_route, scatter_reduce_by_index
+    from jax.sharding import PartitionSpec as P
+
+    rmax = float(np.sqrt(rperp ** 2 + rpar ** 2))
+    if box is None:
+        lo = np.asarray(jnp.min(pos, axis=0))
+        work = np.asarray(jnp.max(pos, axis=0)) - lo + 1e-3
+        pos = pos - jnp.asarray(lo, pos.dtype)
+        periodic = False
+    else:
+        work = np.ones(3) * np.asarray(box, dtype='f8')
+
+    nproc = mesh_size(mesh)
+    N = int(pos.shape[0])
+
+    if nproc == 1 or rmax > work[0] / nproc:
+        grid = DeviceGridHash(jnp.asarray(pos), work, rmax,
+                              periodic=periodic)
+        rank_s = jnp.asarray(rank)[grid.order]
+
+        sweep = jax.jit(lambda c: _cylinder_sweep(
+            grid, rank_s, c, los, rperp, rpar))
+        central = jnp.ones(N, bool)
+        while True:
+            bestj = sweep(central)
+            central_new = bestj < 0
+            if bool(jnp.all(central_new == central)):
+                break
+            central = central_new
+        haloid_s = jnp.where(bestj >= 0,
+                             grid.order.astype(jnp.int32)[
+                                 jnp.maximum(bestj, 0)], -1)
+        sat = jnp.zeros(N, bool).at[grid.order].set(bestj >= 0)
+        haloid = jnp.full(N, -1, jnp.int32).at[grid.order].set(haloid_s)
+        return np.asarray(sat), np.asarray(haloid)
+
+    # distributed: slab owners + both-side ghosts; re-ship central
+    # flags along the frozen plan each round
+    route, f, live = slab_route(pos, work, rmax, mesh, ghosts='both',
+                                periodic=periodic)
+    gid = shard_leading(mesh, jnp.arange(N, dtype=jnp.int32))
+    own = jnp.concatenate(
+        [jnp.ones(N, bool)] + [jnp.zeros(N, bool)] * (f - 1))
+    rank_j = jnp.asarray(rank, jnp.int32)
+    (pos_r, gid_r, rank_r, own_r, live_r), ok, _ = route.exchange(
+        [jnp.concatenate([pos] * f),
+         jnp.concatenate([gid] * f),
+         jnp.concatenate([rank_j] * f), own, live])
+    valid = ok & live_r
+
+    def round_local(p, v, rank_l, central_l, gid_l, own_l):
+        grid = DeviceGridHash(p, work, rmax, valid=v,
+                              periodic=periodic, axis_name=AXIS)
+        rank_s = rank_l[grid.order]
+        central_s = central_l[grid.order] & grid.valid_s
+        bestj = _cylinder_sweep(grid, rank_s, central_s, los,
+                                rperp, rpar)
+        gid_s = gid_l[grid.order]
+        haloid_s = jnp.where(bestj >= 0,
+                             gid_s[jnp.maximum(bestj, 0)], -1)
+        S = p.shape[0]
+        sat_l = jnp.zeros(S, bool).at[grid.order].set(bestj >= 0)
+        haloid_out = jnp.full(S, -1, jnp.int32).at[grid.order].set(
+            haloid_s)
+        return sat_l, haloid_out
+
+    round_fn = jax.jit(jax.shard_map(
+        round_local, mesh=mesh,
+        in_specs=(P(AXIS, None),) + (P(AXIS),) * 5,
+        out_specs=(P(AXIS), P(AXIS))))
+
+    central = jnp.ones(N, bool)
+    own_live = own_r & valid
+    while True:
+        central_f = jnp.concatenate([central] * f)
+        (central_r,), _, _ = route.exchange([central_f])
+        sat_r, haloid_r = round_fn(pos_r, valid, rank_r,
+                                   central_r & valid, gid_r, own_r)
+        sat_g = scatter_reduce_by_index(
+            gid_r, sat_r.astype(jnp.int32), N, mesh, op='max',
+            valid=own_live)[:N] > 0
+        central_new = ~sat_g
+        if bool(jnp.all(central_new == central)):
+            haloid = scatter_reduce_by_index(
+                gid_r, haloid_r, N, mesh, op='max',
+                valid=own_live)[:N]
+            haloid = jnp.where(sat_g, haloid, -1)
+            return np.asarray(sat_g), np.asarray(haloid)
+        central = central_new
 
 
 class CylindricalGroups(object):
@@ -53,113 +186,34 @@ class CylindricalGroups(object):
         flat_sky_los = np.asarray(flat_sky_los, dtype='f8')
         self.attrs = dict(rperp=rperp, rpar=rpar, periodic=periodic,
                           flat_sky_los=flat_sky_los, rankby=rankby)
+        box = None
         if BoxSize is not None:
-            self.attrs['BoxSize'] = np.ones(3) * np.asarray(BoxSize)
+            box = np.ones(3) * np.asarray(BoxSize)
+            self.attrs['BoxSize'] = box
 
-        pos = as_numpy(source['Position'])
-        N = len(pos)
-
-        # descending rank order
+        N = source.csize
+        # descending rank order (host: the keys are small 1-D columns;
+        # the reference sorts them globally with mpsort, cgm.py:150)
         if rankby:
-            keys = tuple(as_numpy(source[c]) for c in
-                         reversed(rankby))
+            keys = tuple(as_numpy(source[c]) for c in reversed(rankby))
             order = np.lexsort(keys)[::-1]
         else:
             order = np.arange(N)
-        rank_of = np.empty(N, dtype='i8')
-        rank_of[order] = np.arange(N)
+        rank_of = np.empty(N, dtype='i4')
+        rank_of[order] = np.arange(N, dtype='i4')
 
-        box = self.attrs.get('BoxSize', None)
-        rmax = np.sqrt(rperp ** 2 + rpar ** 2)
+        pos = jnp.asarray(source['Position'])
+        sat, haloid = _cgm_classify(pos, rank_of, box, rperp, rpar,
+                                    flat_sky_los,
+                                    self.attrs['periodic'], self.comm)
 
-        # candidate pairs from the grid hash (host side)
-        pairs = self._candidate_pairs(pos, box, rmax, periodic)
-
-        los = flat_sky_los
-        cgm_type = np.full(N, 2, dtype='i4')     # default isolated
-        cgm_haloid = np.full(N, -1, dtype='i8')
-        nsat = np.zeros(N, dtype='i8')
-
-        # neighbor lists restricted to the cylinder
-        nbr = [[] for _ in range(N)]
-        for i, j in pairs:
-            d = pos[i] - pos[j]
-            if periodic:
-                d = d - np.round(d / box) * box
-            dpar = abs(np.dot(d, los))
-            dperp2 = (d ** 2).sum() - dpar ** 2
-            if dpar <= rpar and dperp2 <= rperp ** 2:
-                nbr[i].append(j)
-                nbr[j].append(i)
-
-        # greedy sweep in rank order
-        for i in order:
-            if cgm_type[i] != 2 and cgm_type[i] != 0:
-                continue
-            # find higher-ranked centrals in the cylinder
-            best = -1
-            bestr = np.inf
-            for j in nbr[i]:
-                if rank_of[j] < rank_of[i] and cgm_type[j] in (0, 2):
-                    d = pos[i] - pos[j]
-                    if periodic:
-                        d = d - np.round(d / box) * box
-                    r2 = (d ** 2).sum()
-                    if r2 < bestr:
-                        bestr = r2
-                        best = j
-            if best >= 0:
-                cgm_type[i] = 1
-                cgm_haloid[i] = best
-                if cgm_type[best] == 2:
-                    cgm_type[best] = 0
-                nsat[best] += 1
-            # else stays central candidate (isolated unless it gains
-            # satellites later)
-
-        cgm_type[(cgm_type == 2) & (nsat > 0)] = 0
+        nsat = np.bincount(haloid[sat], minlength=N).astype('i8')
+        cgm_type = np.full(N, 2, dtype='i4')
+        cgm_type[sat] = 1
+        cgm_type[~sat & (nsat > 0)] = 0
+        cgm_haloid = np.where(sat, haloid, -1).astype('i8')
 
         self.groups = ArrayCatalog(
             {'cgm_type': cgm_type, 'cgm_haloid': cgm_haloid,
              'num_cgm_sats': nsat}, comm=self.comm)
         self.groups.attrs.update(self.attrs)
-
-    @staticmethod
-    def _candidate_pairs(pos, box, rmax, periodic):
-        """Unique candidate pairs within rmax via cell hashing."""
-        if box is None:
-            lo = pos.min(axis=0)
-            span = pos.max(axis=0) - lo + 1e-3
-            work = span
-            p = pos - lo
-        else:
-            work = np.asarray(box, dtype='f8')
-            p = pos
-        ncell = np.maximum(np.floor(work / rmax), 1).astype('i8')
-        ncell = np.minimum(ncell, 64)
-        cellsize = work / ncell
-        ci = np.clip((p / cellsize).astype('i8'), 0, ncell - 1)
-        flat = (ci[:, 0] * ncell[1] + ci[:, 1]) * ncell[2] + ci[:, 2]
-        from collections import defaultdict
-        cells = defaultdict(list)
-        for idx, f in enumerate(flat):
-            cells[int(f)].append(idx)
-
-        from ..ops.gridhash import neighbor_offsets
-        offs = neighbor_offsets(ncell, periodic=periodic)
-        pairs = set()
-        for f, members in cells.items():
-            c0 = np.array([f // (ncell[1] * ncell[2]),
-                           (f // ncell[2]) % ncell[1], f % ncell[2]])
-            for off in offs:
-                nc = c0 + off
-                if periodic:
-                    nc = nc % ncell
-                elif np.any(nc < 0) or np.any(nc >= ncell):
-                    continue
-                nf = int((nc[0] * ncell[1] + nc[1]) * ncell[2] + nc[2])
-                for i in members:
-                    for j in cells.get(nf, ()):
-                        if i < j:
-                            pairs.add((i, j))
-        return pairs

@@ -78,7 +78,12 @@ def _se_chunk_zeta(grid, w_s, ylms, nbins, r2edges):
             nm = 2 * ell + 1
             a = alm[:, ilm:ilm + nm, :]  # (C, nm, nbins)
             z = jnp.einsum('i,imb,imc->bc', w1c, a, a)
-            outs.append(z * (4 * np.pi / nm))
+            # reference normalization: corr_ell such that
+            # corr_ell * (4pi)^2 / (2ell+1) = sum_i w_i w_j w_k
+            # P_ell(rhat_ij . rhat_ik)  (the Eisenstein C++ output
+            # convention the reference's golden test encodes;
+            # test_threeptcf.py:54)
+            outs.append(z / (4 * np.pi))
             ilm += nm
         return jnp.stack(outs)
 

@@ -48,6 +48,12 @@ def hist2d_mxu(abin, bbin, weights, NA, NB, chunk=131072,
     Traceable (jit-safe); shapes are static. Elements with bins outside
     the valid range must be pre-clipped by the caller (the fftpower
     binning reserves explicit under/overflow bins, so this holds).
+
+    Precision contract: weights are cast to f32 before the bf16 hi/lo
+    split, so per-element fidelity is f32-grade (~1e-7 relative) even
+    for f64 inputs; ``acc_dtype`` only sets the cross-chunk
+    accumulation width. Callers needing exact f64 sums must use the
+    bincount path (``hist2d_weighted`` auto-picks it off-TPU).
     """
     M = int(abin.shape[0])
     nw = len(weights)

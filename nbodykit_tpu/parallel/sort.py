@@ -141,9 +141,22 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
                         out_specs=out_specs)(keys, *vals)
 
     dropped = int(res[-1])
+    # skewed keys overflow the sample-sort buckets: retry with grown
+    # capacity (``local`` closes over ``capacity`` and is re-traced per
+    # call, so the new value takes effect) — the analog of the
+    # reference's chunk-backoff retry (source/mesh/catalog.py:275-315).
+    # capacity = nper is provably sufficient (each sender holds only
+    # nper rows), so the loop always terminates with zero overflow.
+    cap_max = nper
+    while dropped > 0 and capacity < cap_max:
+        capacity = min(capacity * 4, cap_max)
+        res = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs)(keys, *vals)
+        dropped = int(res[-1])
     dist_sort._last_dropped = dropped  # introspection for tests
     if dropped > 0:
-        # pathological skew: exact single-device fallback
+        # unreachable in principle (capacity reaches nper); kept as a
+        # correctness backstop: exact single-device fallback
         order = jnp.argsort(keys)
         out = (keys[order],) if values is None else \
             (keys[order], values[order])

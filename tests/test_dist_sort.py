@@ -56,3 +56,15 @@ def test_dist_sort_fast_path_engages():
     keys = rng.randint(0, 1 << 30, 4096).astype(np.int64)
     dist_sort(jnp.asarray(keys), mesh=cpu_mesh())
     assert dist_sort._last_dropped == 0
+
+
+def test_dist_sort_skew_handled_by_capacity_retry():
+    # heavy duplication overflows the first-attempt buckets; the
+    # grown-capacity retry must absorb it without the single-device
+    # fallback (which _last_dropped > 0 would indicate)
+    rng = np.random.RandomState(11)
+    keys = np.concatenate([np.full(3000, 42, dtype=np.int64),
+                           rng.randint(0, 1 << 20, 1096)])
+    ks = dist_sort(jnp.asarray(keys), mesh=cpu_mesh())
+    np.testing.assert_array_equal(np.asarray(ks), np.sort(keys))
+    assert dist_sort._last_dropped == 0

@@ -417,3 +417,25 @@ def test_kddensity_two_device_wraparound_ghosts(cpu8):
         kd = KDDensity(cat, margin=0.5)
     np.testing.assert_allclose(np.asarray(kd.density),
                                np.asarray(kd1.density), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_cgm_distributed_matches_single(cpu8):
+    """CylindricalGroups on a sharded catalog: the fixpoint rounds with
+    per-round ghost refresh must reproduce the single-device
+    classification exactly."""
+    from nbodykit_tpu.algorithms.cgm import CylindricalGroups
+    box = 80.0
+    rng = np.random.RandomState(17)
+    pos = clustered_positions(1500, box, nblob=25, sigma=1.0, seed=17)
+    mass = rng.uniform(1.0, 100.0, 1500)
+    cat1 = ArrayCatalog({'Position': pos, 'Mass': mass}, BoxSize=box,
+                        comm=None)
+    g1 = CylindricalGroups(cat1, rankby='Mass', rperp=1.5, rpar=3.0)
+    with use_mesh(cpu8):
+        cat = ArrayCatalog({'Position': pos, 'Mass': mass},
+                           BoxSize=box)
+        gd = CylindricalGroups(cat, rankby='Mass', rperp=1.5, rpar=3.0)
+    for col in ('cgm_type', 'cgm_haloid', 'num_cgm_sats'):
+        np.testing.assert_array_equal(np.asarray(gd.groups[col]),
+                                      np.asarray(g1.groups[col]))

@@ -51,7 +51,8 @@ def test_3pcf_brute_force(ell):
     cat = ArrayCatalog({'Position': pos, 'Weight': w}, BoxSize=20.0)
     edges = np.array([0.5, 4.0, 8.0])
     r = SimulationBox3PCF(cat, poles=[ell], edges=edges)
-    want = brute_zeta(pos, w, edges, ell, 20.0)
+    want = brute_zeta(pos, w, edges, ell, 20.0) \
+        * (2 * ell + 1) / (4 * np.pi) ** 2
     np.testing.assert_allclose(np.asarray(r.poles['corr_%d' % ell]),
                                want, rtol=1e-6, atol=1e-8)
 
@@ -179,9 +180,10 @@ def test_3pcf_nonperiodic_no_double_count():
 
     r = Direct()
     # each point has exactly one neighbor at separation ~0.9-1.0:
-    # S_0 = sum_i w_i * (1*1) * P_0 = 4
+    # sum_i w_i * (1*1) * P_0 = 4, scaled by the reference corr
+    # normalization (2l+1)/(4pi)^2
     np.testing.assert_allclose(np.asarray(r.poles['corr_0'])[0, 0],
-                               4.0, rtol=1e-6)
+                               4.0 / (4 * np.pi) ** 2, rtol=1e-6)
 
 
 def test_fof_nonperiodic():

@@ -1,0 +1,56 @@
+"""3PCF validated against the Slepian–Eisenstein C++ code's output.
+
+The reference repository ships a 1000-particle sample and the zeta
+multipoles computed by Daniel Eisenstein's independent C++
+implementation (nbodykit/algorithms/tests/test_threeptcf.py:10-13,
+data/threeptcf_sim_{data,result}.dat) — a cross-implementation oracle
+for ell = 0..10. The files are read from the reference tree (they are
+third-party test data, not framework code); the test skips when the
+tree is absent.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nbodykit_tpu.lab import ArrayCatalog
+from nbodykit_tpu.algorithms.threeptcf import SimulationBox3PCF
+
+DATA_DIR = '/root/reference/nbodykit/algorithms/tests/data'
+
+
+@pytest.mark.slow
+def test_sim_3pcf_matches_eisenstein_code():
+    fdata = os.path.join(DATA_DIR, 'threeptcf_sim_data.dat')
+    fres = os.path.join(DATA_DIR, 'threeptcf_sim_result.dat')
+    if not (os.path.exists(fdata) and os.path.exists(fres)):
+        pytest.skip("reference golden data not available")
+
+    BoxSize = 400.0
+    raw = np.loadtxt(fdata)
+    pos = raw[:, :3] * BoxSize
+    w = raw[:, 3]
+
+    nbins = 8
+    edges = np.linspace(0, 200.0, nbins + 1)
+    ells = list(range(0, 11))
+
+    cat = ArrayCatalog({'Position': pos, 'Weight': w},
+                       BoxSize=BoxSize, comm=None)
+    r = SimulationBox3PCF(cat, ells, edges, BoxSize=BoxSize,
+                          weight='Weight')
+
+    truth = np.empty((nbins, nbins, len(ells)))
+    with open(fres) as ff:
+        for line in ff:
+            fields = line.split()
+            i, j = int(fields[0]), int(fields[1])
+            truth[i, j, :] = [float(x) for x in fields[2:]]
+            truth[j, i, :] = truth[i, j, :]
+
+    for i, ell in enumerate(ells):
+        x = np.asarray(r.poles['corr_%d' % ell])
+        np.testing.assert_allclose(
+            x * (4 * np.pi) ** 2 / (2 * ell + 1), truth[..., i],
+            rtol=1e-3, err_msg='mismatch for ell=%d' % ell)
