@@ -11,28 +11,38 @@ Prints ONE JSON line on stdout:
 
 ``vs_baseline`` is (estimated reference wallclock) / (ours) — >1 means
 faster than the baseline. The reference publishes no absolute numbers
-(BASELINE.md); we use a 30 s nominal for the dm_like-scale FFTPower on a
-16-rank MPI node (the reference's example production config,
+(BASELINE.md); we use a 30 s nominal for the dm_like-scale FFTPower on
+a 16-rank MPI node (the reference's example production config,
 nersc/example-job.slurm), documented here so the denominator is stable
 across rounds.
 
-Robustness (round-2 hardening — the round-1 bench burned its whole
-window on a wedged axon tunnel):
-- the orchestrator process NEVER imports jax; every probe/measurement
-  runs in a subprocess with a hard timeout, so a wedged backend init
-  cannot consume the window;
-- a cheap backend health probe gates everything; if it fails we print a
-  JSON line immediately (value -1) instead of timing out silently;
-- configs run smallest-first so SOME number always exists, escalating
-  to the north-star config; the largest successful config is reported;
-- a paint-only microbenchmark is recorded to stderr and
-  BENCH_DETAIL.json for kernel-level tracking.
+Round-3 redesign (rounds 1+2 produced no number — VERDICT.md weak #1):
+the axon TPU tunnel WEDGES when a process with in-flight TPU work is
+timeout-killed, and rounds 1+2 both died that way (r01: the bench
+itself was killed at budget; r02: the probe subprocess was killed at
+150 s and every later subprocess hung). Therefore:
+
+- ONE persistent worker process runs the whole ladder; it is spawned
+  detached (its own session) and is NEVER killed, by anyone. If it
+  hangs, it is left hanging and the orchestrator reports what was
+  already flushed.
+- The worker starts with the tiniest possible op and escalates
+  Nmesh 128 -> 256 -> 512 -> 1024 smallest-first, so SOME number
+  exists as early as possible.
+- The worker atomically rewrites BENCH_DETAIL.json after EVERY
+  step (write temp + rename) — partial progress survives any failure.
+- The orchestrator (no jax in-process) polls BENCH_DETAIL.json until
+  the worker finishes or the budget elapses, then prints the largest
+  successful config. It exits 0 with a value even when the tunnel is
+  wedged (value -1 + diagnosis), never leaving an empty artifact.
+- Per-config phase breakdown (paint / FFT / binning / fused) plus
+  throughput estimates (Mpart/s, effective GB/s) are recorded in
+  BENCH_DETAIL.json.
 
 Subcommands (internal):
-    bench.py --probe                 backend sanity check
-    bench.py --config N NPART [m]    one fftpower config, JSON on stdout
-    bench.py --paint N NPART         paint-only microbench
-    bench.py --autotune N NPART      pick paint kernel ('sort'|'scatter')
+    bench.py --worker                 run the full ladder (imports jax)
+    bench.py --config N NPART [m]     one fftpower config, JSON on stdout
+    bench.py --paint N NPART          paint-only microbench
 """
 
 import json
@@ -41,15 +51,20 @@ import subprocess
 import sys
 import time
 
+HERE = os.path.dirname(os.path.abspath(__file__))
+DETAIL_PATH = os.path.join(HERE, 'BENCH_DETAIL.json')
+WORKER_LOG = os.path.join(HERE, 'BENCH_WORKER.log')
 TOTAL_BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', 1500))
-PROBE_TIMEOUT_S = float(os.environ.get('BENCH_PROBE_TIMEOUT_S', 150))
 NOMINAL_BASELINE_S = 30.0  # see module docstring
+
+# v5e single-chip nominals for efficiency estimates
+V5E_HBM_GBPS = 819.0
 
 
 def _setup_jax():
-    """Import jax safely under axon: honor an explicit cpu request the
-    way __graft_entry__.py does (the sitecustomize overrides
-    JAX_PLATFORMS/XLA_FLAGS env vars, so re-assert via jax.config)."""
+    """Import jax, honoring an explicit cpu request the way
+    __graft_entry__.py does (the sitecustomize overrides JAX_PLATFORMS/
+    XLA_FLAGS env vars, so re-assert via jax.config)."""
     import re
     import jax
     if 'cpu' in os.environ.get('JAX_PLATFORMS', ''):
@@ -63,27 +78,37 @@ def _setup_jax():
     return jax
 
 
-def cmd_probe():
-    jax = _setup_jax()
+def _sync(jax, out):
+    """Force completion by transferring one scalar to the host.
+
+    ``jax.block_until_ready`` does NOT reliably wait under the axon
+    tunnel (async relay) — round-2 measurements with it reported a
+    1e7-particle paint at 0.1 ms. A scalar device->host transfer is an
+    actual synchronization point.
+    """
     import jax.numpy as jnp
-    d = jax.devices()
-    x = jnp.ones((128, 128))
-    s = float((x @ x).sum())
-    assert s == 128.0 * 128 * 128
-    print(json.dumps({"platform": d[0].platform,
-                      "kind": getattr(d[0], 'device_kind', '?'),
-                      "n": len(d)}))
-    return 0
+    leaf = jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0]
+    if jnp.iscomplexobj(leaf):
+        # axon implements no complex host transfers; reduce on device
+        leaf = jnp.abs(leaf)
+    return float(leaf)
 
 
-def _bench_fftpower_fn(pm, Npart, resampler='cic', slab_chunks=16):
+def _make_pos(jax, jnp, Npart, L, seed=7):
+    pos = jax.random.uniform(jax.random.key(seed), (Npart, 3),
+                             jnp.float32, 0.0, L)
+    _sync(jax, pos)
+    return pos
+
+
+def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
     """The fused pipeline with slab-chunked (k,mu) binning.
 
-    Binning loops over chunks of the complex field's leading axis with a
-    fori_loop so no full-mesh f32 temporaries (k2/mu/digitize indices)
-    are ever live at once — at Nmesh=1024 the unchunked version needs
-    ~6 extra 2.1 GB buffers, which does not fit v5e HBM alongside the
-    FFT workspace.
+    Binning loops over chunks of the complex field's leading axis with
+    a fori_loop so no full-mesh f32 temporaries (k2/mu/digitize
+    indices) are ever live at once — at Nmesh=1024 the unchunked
+    version needs ~6 extra 2.1 GB buffers, which does not fit v5e HBM
+    alongside the FFT workspace.
     """
     import numpy as np
     import jax
@@ -115,15 +140,7 @@ def _bench_fftpower_fn(pm, Npart, resampler='cic', slab_chunks=16):
                           .astype('i4')).reshape(1, N0c, 1)
     iz_full = jnp.asarray(np.arange(nz, dtype='i4')).reshape(1, 1, nz)
 
-    def fftpower(pos):
-        n = pos.shape[0]
-        field = pm.paint(pos, 1.0, resampler=resampler)
-        field = field / (n / pm.Ntot)
-        c = pm.r2c(field)
-        w = pm.k_list(dtype=jnp.float32, circular=True)
-        c = transfer(w, c)
-        p3 = (jnp.abs(c) ** 2).astype(jnp.float32) * V
-        p3 = p3.at[0, 0, 0].set(0.0)
+    def binning(p3):
         herm_z = pm.hermitian_weights(dtype=jnp.float32)  # (1,1,nz)
 
         def body(i, acc):
@@ -158,30 +175,42 @@ def _bench_fftpower_fn(pm, Npart, resampler='cic', slab_chunks=16):
                 jnp.zeros((Nx + 2, Nmu + 2), jnp.float32))
         return jax.lax.fori_loop(0, slab_chunks, body, init)
 
-    return fftpower
+    def power3d(pos):
+        n = pos.shape[0]
+        field = pm.paint(pos, 1.0, resampler=resampler)
+        field = field / (n / pm.Ntot)
+        c = pm.r2c(field)
+        w = pm.k_list(dtype=jnp.float32, circular=True)
+        c = transfer(w, c)
+        p3 = (jnp.abs(c) ** 2).astype(jnp.float32) * V
+        return p3.at[0, 0, 0].set(0.0)
+
+    def fftpower(pos):
+        return binning(power3d(pos))
+
+    phases = {
+        'paint': lambda pos: pm.paint(pos, 1.0, resampler=resampler),
+        'paint_fft': lambda pos: pm.r2c(
+            pm.paint(pos, 1.0, resampler=resampler)),
+        'power3d': power3d,
+    }
+    return fftpower, phases
 
 
-def _make_pos(jax, jnp, Npart, L, seed=7):
-    pos = jax.random.uniform(jax.random.key(seed), (Npart, 3),
-                             jnp.float32, 0.0, L)
-    _sync(jax, pos)
-    return pos
+def _time_fn(jax, fn, args, reps):
+    out = fn(*args)
+    t0 = time.time()
+    _sync(jax, out)
+    compile_s = time.time() - t0  # first-call includes compile
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+        _sync(jax, out)
+    return (time.time() - t0) / reps, compile_s
 
 
-def _sync(jax, out):
-    """Force completion by transferring one scalar to the host.
-
-    ``jax.block_until_ready`` does NOT reliably wait under the axon
-    tunnel (async relay) — round-2 measurements with it reported a
-    1e7-particle paint at 0.1 ms. A scalar device->host transfer is an
-    actual synchronization point.
-    """
-    import jax.numpy as jnp
-    leaf = jax.tree.leaves(out)[0]
-    return float(jnp.asarray(leaf).ravel()[0])
-
-
-def cmd_config(Nmesh, Npart, method='scatter', reps=3):
+def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
+    """One full config measurement; returns a result dict."""
     jax = _setup_jax()
     import jax.numpy as jnp
     import nbodykit_tpu
@@ -190,27 +219,44 @@ def cmd_config(Nmesh, Npart, method='scatter', reps=3):
     nbodykit_tpu.set_options(paint_method=method)
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     pos = _make_pos(jax, jnp, Npart, 1000.0)
-    fn = jax.jit(_bench_fftpower_fn(pm, Npart))
-    t0 = time.time()
-    _sync(jax, fn(pos))
-    compile_s = time.time() - t0
-    t0 = time.time()
-    for _ in range(reps):
-        out = fn(pos)
-        _sync(jax, out)
-    dt = (time.time() - t0) / reps
-    print(json.dumps({
+    fused, phase_fns = _bench_fftpower_fn(pm)
+
+    rec = {
         "metric": "fftpower_wallclock_nmesh%d_npart%.0e" % (Nmesh, Npart),
-        "value": round(dt, 4),
-        "unit": "s",
-        "vs_baseline": round(NOMINAL_BASELINE_S / dt, 2),
-        "compile_s": round(compile_s, 1),
-        "paint_method": method,
-    }))
-    return 0
+        "unit": "s", "paint_method": method,
+    }
+    dt, compile_s = _time_fn(jax, jax.jit(fused), (pos,), reps)
+    rec.update(value=round(dt, 4), compile_s=round(compile_s, 1),
+               vs_baseline=round(NOMINAL_BASELINE_S / dt, 2))
+
+    if phases:
+        field_bytes = 4.0 * Nmesh ** 3
+        t_paint, _ = _time_fn(jax, jax.jit(phase_fns['paint']),
+                              (pos,), reps)
+        t_pfft, _ = _time_fn(jax, jax.jit(phase_fns['paint_fft']),
+                             (pos,), reps)
+        t_p3, _ = _time_fn(jax, jax.jit(phase_fns['power3d']),
+                           (pos,), reps)
+        t_fft = max(t_pfft - t_paint, 0.0)
+        t_bin = max(dt - t_p3, 0.0)
+        rec['phases'] = {
+            'paint_s': round(t_paint, 4),
+            'fft_s': round(t_fft, 4),
+            'binning_s': round(t_bin, 4),
+            'paint_mpart_per_s': round(Npart / t_paint / 1e6, 1),
+            # rfft of N^3 reads+writes the field ~6x across the three
+            # axis passes (transposed layout): a rough effective-BW
+            # yardstick against the 819 GB/s v5e HBM nominal
+            'fft_eff_gbps': round(6 * field_bytes / max(t_fft, 1e-9)
+                                  / 1e9, 1),
+            'fft_frac_hbm_peak': round(
+                6 * field_bytes / max(t_fft, 1e-9) / 1e9
+                / V5E_HBM_GBPS, 3),
+        }
+    return rec
 
 
-def cmd_paint(Nmesh, Npart, method='scatter', reps=3):
+def run_paint(Nmesh, Npart, method='scatter', reps=3):
     """Paint-only microbenchmark (the #1 perf risk, SURVEY §7)."""
     jax = _setup_jax()
     import jax.numpy as jnp
@@ -221,174 +267,178 @@ def cmd_paint(Nmesh, Npart, method='scatter', reps=3):
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic'))
-    _sync(jax, fn(pos))
-    t0 = time.time()
-    for _ in range(reps):
-        out = fn(pos)
-        _sync(jax, out)
-    dt = (time.time() - t0) / reps
-    print(json.dumps({
+    dt, _ = _time_fn(jax, fn, (pos,), reps)
+    return {
         "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"
                   % (Nmesh, Npart, method),
         "value": round(dt, 4), "unit": "s",
         "mpart_per_s": round(Npart / dt / 1e6, 1),
-    }))
-    return 0
+    }
 
 
-def cmd_autotune(Nmesh, Npart):
-    jax = _setup_jax()
-    import jax.numpy as jnp
-    import nbodykit_tpu
-    from nbodykit_tpu.pmesh import ParticleMesh
+# ---------------------------------------------------------------------------
+# worker: runs the whole ladder in ONE process, flushing after each step
 
-    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
-    pos = _make_pos(jax, jnp, Npart, 1000.0)
-    times = {}
-    for method in ['sort', 'scatter']:
+def _flush_detail(detail):
+    tmp = DETAIL_PATH + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(detail, f, indent=1)
+    os.replace(tmp, DETAIL_PATH)
+
+
+def cmd_worker():
+    detail = {"state": "starting", "t0": time.time(), "probe": None,
+              "paint": [], "configs": [], "done": False}
+    _flush_detail(detail)
+
+    def note(msg):
+        print("[worker %.0fs] %s" % (time.time() - detail['t0'], msg),
+              flush=True)
+
+    # tiniest possible op first: if the tunnel is wedged we hang HERE,
+    # with state=probing on disk for the orchestrator to report
+    detail['state'] = 'probing'
+    _flush_detail(detail)
+    try:
+        jax = _setup_jax()
+        import jax.numpy as jnp
+        d = jax.devices()
+        x = jnp.ones((64, 64))
+        s = float((x @ x).sum())
+        assert s == 64.0 * 64 * 64
+        detail['probe'] = {"platform": d[0].platform,
+                           "kind": getattr(d[0], 'device_kind', '?'),
+                           "n": len(d),
+                           "dt": round(time.time() - detail['t0'], 1)}
+        note("probe ok: %s" % detail['probe'])
+    except Exception as e:
+        detail['probe'] = {"error": str(e)[:300]}
+        detail['state'] = 'probe_failed'
+        detail['done'] = True
+        _flush_detail(detail)
+        note("probe failed: %s" % e)
+        return 1
+    detail['state'] = 'running'
+    _flush_detail(detail)
+
+    # paint microbench at a mid scale (cheap, kernel-level tracking)
+    try:
+        p = run_paint(256, 1_000_000)
+        detail['paint'].append(p)
+        note("paint micro: %s" % p)
+    except Exception as e:
+        detail['paint'].append({"error": str(e)[:300]})
+        note("paint micro failed: %s" % e)
+    _flush_detail(detail)
+
+    # smallest-first ladder up to the north-star config; every step is
+    # sized to finish (clean Python exceptions, e.g. OOM, do NOT wedge
+    # the tunnel — only kills do, and nobody kills us)
+    ladder = [(128, 100_000), (256, 1_000_000), (512, 10_000_000),
+              (1024, 10_000_000), (1024, 100_000_000)]
+    for Nmesh, Npart in ladder:
+        detail['state'] = 'config_nmesh%d_npart%.0e' % (Nmesh, Npart)
+        _flush_detail(detail)
         try:
-            with nbodykit_tpu.set_options(paint_method=method):
-                f = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic'))
-                _sync(jax, f(pos))
-                t0 = time.time()
-                for _ in range(2):
-                    out = f(pos)
-                    _sync(jax, out)
-                times[method] = (time.time() - t0) / 2
+            res = run_config(Nmesh, Npart)
+            detail['configs'].append(res)
+            note("ok: %s" % res)
         except Exception as e:
-            print("paint method %s failed: %s" % (method, str(e)[:120]),
-                  file=sys.stderr)
-            times[method] = float('inf')
-    best = min(times, key=times.get)
-    print(json.dumps({"best": best,
-                      "times": {k: (round(v, 4) if v != float('inf')
-                                    else None)
-                                for k, v in times.items()}}))
+            detail['configs'].append({
+                "metric": "fftpower_nmesh%d_npart%.0e" % (Nmesh, Npart),
+                "error": str(e)[:300]})
+            note("config Nmesh=%d Npart=%d failed: %s"
+                 % (Nmesh, Npart, str(e)[:200]))
+            _flush_detail(detail)
+            break
+        _flush_detail(detail)
+
+    detail['state'] = 'done'
+    detail['done'] = True
+    detail['total_s'] = round(time.time() - detail['t0'], 1)
+    _flush_detail(detail)
+    note("worker done in %.0fs" % detail['total_s'])
     return 0
 
 
 # ---------------------------------------------------------------------------
-# orchestrator (no jax in this process)
+# orchestrator (no jax in this process; never kills anything)
 
-def _run_sub(args, timeout):
-    """Run a bench.py subcommand; return parsed last-line JSON or None."""
-    cmd = [sys.executable, os.path.abspath(__file__)] + args
-    t0 = time.time()
-    try:
-        r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout)
-    except subprocess.TimeoutExpired:
-        print("[bench] %s TIMED OUT after %.0fs" % (args, timeout),
-              file=sys.stderr)
-        return None
-    dt = time.time() - t0
-    if r.stderr.strip():
-        tail = r.stderr.strip().splitlines()[-8:]
-        print("[bench] %s stderr tail: %s" % (args[0], " | ".join(tail)),
-              file=sys.stderr)
-    if r.returncode != 0:
-        print("[bench] %s rc=%d (%.0fs)" % (args, r.returncode, dt),
-              file=sys.stderr)
-        return None
-    for line in reversed(r.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith('{'):
-            try:
-                return json.loads(line)
-            except ValueError:
-                continue
-    return None
+def _best_from_detail(detail):
+    best = None
+    for rec in detail.get('configs', []):
+        if rec and rec.get('value', None) and rec.get('value', -1) > 0:
+            best = rec
+    return best
 
 
 def main():
     deadline = time.time() + TOTAL_BUDGET_S
-    detail = {"probe": None, "autotune": None, "paint": [], "configs": []}
+    # reset the detail file so we never report a previous round's data
+    _flush_detail({"state": "spawning", "configs": [], "done": False})
 
-    def left():
-        return deadline - time.time()
+    log = open(WORKER_LOG, 'w')
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), '--worker'],
+        stdout=log, stderr=subprocess.STDOUT,
+        start_new_session=True)  # detached: survives our exit/signals
+    print("[bench] worker pid %d (detached; will never be killed)"
+          % proc.pid, file=sys.stderr)
 
-    probe = _run_sub(['--probe'], min(PROBE_TIMEOUT_S, left()))
-    detail['probe'] = probe
-    if probe is None:
-        print(json.dumps({"metric": "fftpower_wallclock", "value": -1,
-                          "unit": "s", "vs_baseline": 0,
-                          "error": "backend probe failed/timed out"}))
-        _dump_detail(detail)
-        return 1
-    print("[bench] backend: %s" % probe, file=sys.stderr)
-
-    # Paint kernel: 'scatter' — measured (with real scalar-transfer
-    # sync) at 256^3/1e6 the sort kernel is ~100x slower on v5e, so
-    # autotuning it at scale just burns budget and risks a timeout-kill
-    # (which wedges the axon tunnel for every later subprocess). The
-    # --autotune subcommand remains for manual kernel comparisons.
-    method = 'scatter'
-
-    # paint microbench at a mid scale
-    if left() > 240:
-        p = _run_sub(['--paint', '512', '10000000', method],
-                     min(420, left()))
-        detail['paint'].append(p)
-        print("[bench] paint micro: %s" % p, file=sys.stderr)
-
-    # smallest-first ladder up to the north-star config; keep the last
-    # success.
-    ladder = [
-        (128, 100_000, 120),
-        (256, 1_000_000, 180),
-        (512, 10_000_000, 480),
-        (1024, 10_000_000, 700),
-        (1024, 100_000_000, 700),
-    ]
-    best = None
-    for Nmesh, Npart, budget in ladder:
-        if left() < budget * 0.5:
-            print("[bench] skipping Nmesh=%d Npart=%d (%.0fs left)"
-                  % (Nmesh, Npart, left()), file=sys.stderr)
+    state = {}
+    while time.time() < deadline:
+        if proc.poll() is not None:
             break
-        res = _run_sub(['--config', str(Nmesh), str(Npart), method],
-                       min(budget, left()))
-        detail['configs'].append(res)
-        if res is None:
-            print("[bench] config Nmesh=%d Npart=%d failed; stopping "
-                  "escalation" % (Nmesh, Npart), file=sys.stderr)
+        try:
+            with open(DETAIL_PATH) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            state = {}
+        if state.get('done'):
             break
-        best = res
-        print("[bench] ok: %s" % res, file=sys.stderr)
+        time.sleep(5)
 
-    _dump_detail(detail)
-    if best is None:
-        print(json.dumps({"metric": "fftpower_wallclock", "value": -1,
-                          "unit": "s", "vs_baseline": 0,
-                          "error": "no config succeeded"}))
-        return 1
-    out = {k: best[k] for k in ("metric", "value", "unit", "vs_baseline")}
-    print(json.dumps(out))
-    return 0
-
-
-def _dump_detail(detail):
     try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               'BENCH_DETAIL.json'), 'w') as f:
-            json.dump(detail, f, indent=1)
-    except OSError:
-        pass
+        with open(DETAIL_PATH) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        state = {}
+
+    best = _best_from_detail(state)
+    if best is not None:
+        out = {k: best[k] for k in ("metric", "value", "unit",
+                                    "vs_baseline")}
+        if not state.get('done'):
+            out['note'] = ('budget elapsed at state=%s; worker left '
+                           'running, larger configs may still land in '
+                           'BENCH_DETAIL.json'
+                           % state.get('state', '?'))
+        print(json.dumps(out))
+        return 0
+
+    why = state.get('state', 'no state file')
+    print(json.dumps({
+        "metric": "fftpower_wallclock", "value": -1, "unit": "s",
+        "vs_baseline": 0,
+        "error": "no config completed (worker state: %s). The worker "
+                 "was NOT killed; if state is 'probing' the axon "
+                 "tunnel is wedged (see BENCH_WORKER.log)" % why}))
+    return 1
 
 
 if __name__ == '__main__':
     argv = sys.argv[1:]
     if not argv:
         sys.exit(main())
-    if argv[0] == '--probe':
-        sys.exit(cmd_probe())
+    if argv[0] == '--worker':
+        sys.exit(cmd_worker())
     if argv[0] == '--config':
-        sys.exit(cmd_config(int(argv[1]), int(argv[2]),
-                            *(argv[3:4] or ['scatter'])))
+        print(json.dumps(run_config(int(argv[1]), int(argv[2]),
+                                    *(argv[3:4] or ['scatter']))))
+        sys.exit(0)
     if argv[0] == '--paint':
-        sys.exit(cmd_paint(int(argv[1]), int(argv[2]),
-                           *(argv[3:4] or ['scatter'])))
-    if argv[0] == '--autotune':
-        sys.exit(cmd_autotune(int(argv[1]), int(argv[2])))
+        print(json.dumps(run_paint(int(argv[1]), int(argv[2]),
+                                   *(argv[3:4] or ['scatter']))))
+        sys.exit(0)
     print("unknown args: %r" % (argv,), file=sys.stderr)
     sys.exit(2)

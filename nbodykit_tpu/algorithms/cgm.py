@@ -4,8 +4,9 @@ Reference: ``nbodykit/algorithms/cgm.py:12`` — the Okumura et al. 2017
 cylindrical grouping method: objects are ranked (e.g. by mass); in rank
 order, an object becomes a *central* if no higher-ranked central lies
 within a cylinder of radius ``rperp`` and half-height ``rpar`` around
-it (along the line of sight), else it is a *satellite* of the nearest
-such central.
+it (along the line of sight), else it is a *satellite* of the
+highest-priority such central (the reference sorts candidate pairs by
+rank and keeps the first, cgm.py:150+).
 
 TPU redesign: the reference resolves the rank order with a sequential
 sweep over mpsort-sorted chunks (cgm.py:150+). The greedy recursion is
@@ -32,9 +33,11 @@ from ..utils import as_numpy
 
 
 def _cylinder_sweep(grid, rank_s, central_s, los, rperp, rpar):
-    """One Jacobi round on sorted slots: per query, the nearest
-    higher-ranked current-central within the cylinder (slot index, or
-    -1)."""
+    """One Jacobi round on sorted slots: per query, the
+    highest-priority (smallest rank) higher-ranked current-central
+    within the cylinder (slot index, or -1) — the reference assigns
+    satellites to the first central in rank order, not the nearest
+    (cgm.py sorts pairs by rank and takes the head)."""
     ci = grid.cell_of(grid.pos_s)
     rp2 = jnp.asarray(float(rperp) ** 2, grid.pos_s.dtype)
     rpar_j = jnp.asarray(float(rpar), grid.pos_s.dtype)
@@ -42,16 +45,16 @@ def _cylinder_sweep(grid, rank_s, central_s, los, rperp, rpar):
     n = grid.pos_s.shape[0]
 
     def body(carry, j, valid, d, r2):
-        bestr, bestj = carry
+        bestrank, bestj = carry
         dpar = jnp.abs(d @ los_j)
         dperp2 = jnp.maximum(r2 - dpar * dpar, 0.0)
         ok = (valid & central_s[j] & (rank_s[j] < rank_s)
               & (dpar <= rpar_j) & (dperp2 <= rp2))
-        better = ok & (r2 < bestr)
-        return (jnp.where(better, r2, bestr),
+        better = ok & (rank_s[j] < bestrank)
+        return (jnp.where(better, rank_s[j], bestrank),
                 jnp.where(better, j, bestj))
 
-    init = (jnp.full(n, jnp.inf, grid.pos_s.dtype),
+    init = (jnp.full(n, jnp.iinfo(jnp.int32).max, jnp.int32),
             jnp.full(n, -1, jnp.int32))
     _, bestj = grid.fold(grid.pos_s, ci, body, init)
     return bestj
@@ -101,7 +104,7 @@ def _cgm_classify(pos, rank, box, rperp, rpar, los, periodic, mesh):
     # distributed: slab owners + both-side ghosts; re-ship central
     # flags along the frozen plan each round
     route, f, live = slab_route(pos, work, rmax, mesh, ghosts='both',
-                                periodic=periodic)
+                                periodic=periodic, balance=True)
     gid = shard_leading(mesh, jnp.arange(N, dtype=jnp.int32))
     own = jnp.concatenate(
         [jnp.ones(N, bool)] + [jnp.zeros(N, bool)] * (f - 1))
@@ -161,8 +164,10 @@ class CylindricalGroups(object):
     uses the z axis), periodic.
 
     Results in :attr:`groups` — ArrayCatalog with ``cgm_type``
-    (0=central, 1=satellite, 2=isolated central), ``cgm_haloid`` (the
-    central's index, for satellites), ``num_cgm_sats`` (for centrals).
+    (0=central, 1=satellite; isolated centrals are type 0 with
+    ``num_cgm_sats == 0``, matching the reference's output schema,
+    cgm.py:133-134,187-188), ``cgm_haloid`` (the central's index, for
+    satellites), ``num_cgm_sats`` (for centrals).
     """
 
     logger = logging.getLogger('CylindricalGroups')
@@ -208,9 +213,8 @@ class CylindricalGroups(object):
                                     self.attrs['periodic'], self.comm)
 
         nsat = np.bincount(haloid[sat], minlength=N).astype('i8')
-        cgm_type = np.full(N, 2, dtype='i4')
+        cgm_type = np.zeros(N, dtype='i4')
         cgm_type[sat] = 1
-        cgm_type[~sat & (nsat > 0)] = 0
         cgm_haloid = np.where(sat, haloid, -1).astype('i8')
 
         self.groups = ArrayCatalog(

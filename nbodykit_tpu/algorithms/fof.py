@@ -92,8 +92,12 @@ def _fof_labels_distributed(pos, BoxSize, ll, mesh, periodic=True,
     box = np.asarray(BoxSize, dtype='f8')
     pos = jnp.asarray(pos)
 
+    # balance=True re-tiles slab widths from the particle histogram
+    # (the reference's domain.loadbalance, fof.py:399) so a clustered
+    # catalog spreads across devices instead of blowing up exchange
+    # capacity on one of them
     route, f, live = slab_route(pos, box, ll, mesh, ghosts='down',
-                                periodic=periodic)
+                                periodic=periodic, balance=True)
     gid = shard_leading(mesh, jnp.arange(N, dtype=jnp.int32))
     pos_f = jnp.concatenate([pos] * f)
     gid_f = jnp.concatenate([gid] * f)

@@ -36,7 +36,7 @@ def _kdd_counts_dist(pos, box, r, mesh, periodic=True):
     N = int(pos.shape[0])
     box = np.asarray(box, dtype='f8')
     route, f, live = slab_route(pos, box, r, mesh, ghosts='both',
-                                periodic=periodic)
+                                periodic=periodic, balance=True)
     gid = shard_leading(mesh, jnp.arange(N, dtype=jnp.int32))
     own = jnp.concatenate(
         [jnp.ones(N, bool)] + [jnp.zeros(N, bool)] * (f - 1))

@@ -231,11 +231,16 @@ def paircount_dist(pos1, w1, pos2, w2, box, edges, mesh, mode='1d',
     p1, p2, work_box, redges, rmax, nb1, nb2, periodic = _mode_setup(
         pos1, pos2, box, edges, mode, Nmu, pimax, grid_origin, periodic)
 
-    # route primaries tight, secondaries with ghosts on both faces
-    route1, f1, live1 = slab_route(p1, work_box, None, mesh,
-                                   ghosts=None, periodic=periodic)
+    # route primaries tight, secondaries with ghosts on both faces;
+    # slab boundaries are balanced on the primaries' histogram
+    # (reference pair_counters/domain.py:256) and SHARED by both
+    # routes so every primary sees its rmax-neighborhood
+    route1, f1, live1 = slab_route(p1, work_box, rmax, mesh,
+                                   ghosts=None, periodic=periodic,
+                                   balance=True)
     route2, f2, live2 = slab_route(p2, work_box, rmax, mesh,
-                                   ghosts='both', periodic=periodic)
+                                   ghosts='both', periodic=periodic,
+                                   edges=route1.edges)
     (p1_r, w1_r), ok1, _ = route1.exchange([p1, w1])
     (p2_r, w2_r, lv2), ok2, _ = route2.exchange(
         [jnp.concatenate([p2] * f2), jnp.concatenate([w2] * f2), live2])

@@ -159,7 +159,8 @@ class Base3PCF(object):
 
         pos = pos - origin
         route, f, live = slab_route(pos, box, rmax, mesh,
-                                    ghosts='both', periodic=periodic)
+                                    ghosts='both', periodic=periodic,
+                                    balance=True)
         own = jnp.concatenate(
             [jnp.ones(N, bool)] + [jnp.zeros(N, bool)] * (f - 1))
         w = jnp.asarray(w)

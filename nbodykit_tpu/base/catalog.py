@@ -256,19 +256,31 @@ class CatalogSource(CatalogSourceBase):
 
     def sort(self, keys, reverse=False, usecols=None):
         """Globally sort by one or more columns (reference
-        base/catalog.py:1100 via mpsort; here a jnp argsort — XLA
-        handles the distributed gather)."""
+        base/catalog.py:1100 via mpsort).
+
+        On a multi-device mesh every combination of multi-key and
+        ``reverse`` runs through the distributed sample sort
+        (parallel/sort.py): columns map to order-preserving unsigned
+        keys (bit-flipped for descending), and multiple keys resolve
+        via least-significant-first stable passes that carry the
+        not-yet-sorted keys and the permutation as all_to_all payload —
+        no global argsort of a gathered key ever appears in the
+        compiled program. Ties keep their original catalog order (also
+        under ``reverse``, where the reference's gather-argsort-flip
+        would invert them)."""
         if isinstance(keys, str):
             keys = [keys]
         cols = usecols or self.columns
         from ..source.catalog.array import ArrayCatalog
-        if len(keys) == 1 and self.comm is not None and \
-                mesh_size(self.comm) > 1 and not reverse:
-            # scalable path: distributed sample sort carrying a
-            # permutation payload (mpsort analog)
-            from ..parallel.sort import dist_sort
+        if self.comm is not None and mesh_size(self.comm) > 1:
+            from ..parallel.sort import dist_sort, sortable_key
+            cur = [sortable_key(self[k], reverse) for k in keys]
             perm = jnp.arange(self._size)
-            _, order = dist_sort(self[keys[0]], perm, self.comm)
+            for j in range(len(cur) - 1, -1, -1):
+                payload = cur[:j] + [perm]
+                _, out = dist_sort(cur[j], payload, self.comm)
+                cur, perm = out[:j], out[j]
+            order = perm
         else:
             order = jnp.argsort(self[keys[-1]])
             for key in reversed(keys[:-1]):

@@ -425,10 +425,37 @@ class BinnedStatistic(object):
     @classmethod
     def from_json(cls, filename, key='data', dims=None, edges=None,
                   **kwargs):
+        """Load from JSON. Accepts both our wrapped layout
+        (``{'data': {dims, edges, data, ...}}``, written by
+        :meth:`to_json`) and the reference's flat layout where ``key``
+        names the structured data array and ``dims``/``edges``/
+        ``coords``/``attrs`` are top-level siblings (written by
+        nbodykit's ``to_json``, read at reference
+        binned_statistic.py:445-504) — archived nbodykit results load
+        unchanged."""
         with open(filename, 'r') as ff:
             state = json.load(ff, cls=JSONDecoder)
         if key in state:
-            state = state[key]
+            inner = state[key]
+            if isinstance(inner, dict) and 'data' in inner:
+                # our wrapped full-state layout
+                obj = cls.from_state(inner)
+                obj.attrs.update(kwargs)
+                return obj
+            # reference flat layout: `inner` is the data array itself
+            dims = state.get('dims', dims)
+            edges = state.get('edges', edges)
+            if dims is None:
+                raise ValueError(
+                    "no `dims` in JSON file; pass dims= explicitly")
+            if edges is None:
+                raise ValueError(
+                    "no `edges` in JSON file; pass edges= explicitly")
+            obj = cls(dims=dims, edges=edges, data=inner,
+                      coords=state.get('coords'))
+            obj.attrs.update(state.get('attrs', {}))
+            obj.attrs.update(kwargs)
+            return obj
         obj = cls.from_state(state)
         obj.attrs.update(kwargs)
         return obj

@@ -61,7 +61,47 @@ class Route(object):
                                 self.capacity)
 
 
-def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True):
+def balanced_slab_edges(x, box0, nproc, rmax=None, oversample=64):
+    """Slab boundaries that equalize per-device particle counts — the
+    analog of the reference's ``domain.loadbalance(domain.load(pos))``
+    re-tiling (fof.py:399, pair_counters/domain.py:256).
+
+    A coarse histogram of ``x`` (``oversample * nproc`` uniform bins,
+    device bincount, tiny) yields the cumulative mass profile; the
+    k-th boundary sits at the N*k/nproc quantile (linear interpolation
+    inside bins). When ``rmax`` is given, every slab is clamped to at
+    least ``rmax`` wide so single-hop ghosting stays valid (callers
+    pre-check nproc * rmax <= box0); balance degrades gracefully where
+    the clamp binds.
+
+    Returns a host (nproc + 1,) float64 array with edges[0] = 0 and
+    edges[-1] = box0.
+    """
+    box0 = float(box0)
+    nbins = int(oversample) * nproc
+    bw = box0 / nbins
+    xb = jnp.clip((jnp.mod(x, box0) / bw).astype(jnp.int32),
+                  0, nbins - 1)
+    hist = np.asarray(jnp.bincount(xb, length=nbins), dtype='f8')
+    csum = np.concatenate([[0.0], np.cumsum(hist)])
+    total = csum[-1]
+    grid = np.linspace(0.0, box0, nbins + 1)
+    if total <= 0:
+        return np.linspace(0.0, box0, nproc + 1)
+    targets = total * np.arange(1, nproc) / nproc
+    cuts = np.interp(targets, csum, grid)
+    edges = np.concatenate([[0.0], cuts, [box0]])
+    if rmax is not None and rmax > 0:
+        m = float(rmax)
+        for k in range(1, nproc):
+            edges[k] = max(edges[k], edges[k - 1] + m)
+        for k in range(nproc - 1, 0, -1):
+            edges[k] = min(edges[k], edges[k + 1] - m)
+    return edges
+
+
+def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True,
+               balance=False, edges=None):
     """Build the (dest, live) plan routing particles + ghost copies to
     x-slab owners.
 
@@ -77,10 +117,18 @@ def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True):
       pair_counters/domain.py:116-127);
     - ``ghosts=None``: no ghosts (tight routing for primaries).
 
+    ``balance=True`` re-tiles the slab boundaries from a particle
+    histogram (:func:`balanced_slab_edges`) so clustered data spreads
+    evenly instead of relying on exchange-capacity growth alone;
+    ``edges`` passes pre-computed boundaries so several routes share
+    one decomposition (pair counting routes primaries and secondaries
+    against the same edges).
+
     Returns (route, payload_head, live) where ``payload_head`` is the
     replication factor f (1, 2 or 3): callers must tile their payloads
     ``jnp.concatenate([a] * f)`` before ``route.exchange`` and AND the
-    returned ``valid`` with ``live`` shipped as a payload.
+    returned ``valid`` with ``live`` shipped as a payload. The route
+    carries ``route.edges`` (None for the uniform tiling) for reuse.
 
     Requires rmax <= box_x / P (single-hop ghosting), mirroring the
     halo-exchange constraint of the paint path.
@@ -89,7 +137,9 @@ def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True):
     n = pos.shape[0]
     if nproc == 1:
         dest = jnp.zeros(n, jnp.int32)
-        return Route(dest, mesh), 1, jnp.ones(n, bool)
+        route = Route(dest, mesh)
+        route.edges = None
+        return route, 1, jnp.ones(n, bool)
 
     box0 = float(np.asarray(box).reshape(-1)[0]
                  if np.ndim(box) else box)
@@ -102,13 +152,29 @@ def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True):
     x = pos[:, 0]
     if periodic:
         x = jnp.mod(x, box0)
-    owner = jnp.clip((x / w).astype(jnp.int32), 0, nproc - 1)
+
+    if edges is None and balance:
+        edges = balanced_slab_edges(x, box0, nproc, rmax)
+    if edges is not None:
+        edges = np.asarray(edges, dtype='f8')
+        edges_j = jnp.asarray(edges, x.dtype)
+        owner = jnp.clip(
+            jnp.searchsorted(edges_j[1:-1], x, side='right')
+            .astype(jnp.int32), 0, nproc - 1)
+        lo_edge = edges_j[owner]
+        hi_edge = edges_j[owner + 1]
+    else:
+        owner = jnp.clip((x / w).astype(jnp.int32), 0, nproc - 1)
+        lo_edge = owner.astype(x.dtype) * w
+        hi_edge = (owner.astype(x.dtype) + 1) * w
 
     if ghosts is None or rmax is None:
-        return Route(owner, mesh), 1, jnp.ones(n, bool)
+        route = Route(owner, mesh)
+        route.edges = edges
+        return route, 1, jnp.ones(n, bool)
 
-    lo_margin = (x - owner.astype(x.dtype) * w) < rmax
-    hi_margin = ((owner.astype(x.dtype) + 1) * w - x) < rmax
+    lo_margin = (x - lo_edge) < rmax
+    hi_margin = (hi_edge - x) < rmax
     if periodic:
         lo_dest = jnp.mod(owner - 1, nproc)
         hi_dest = jnp.mod(owner + 1, nproc)
@@ -122,7 +188,9 @@ def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True):
         dest = jnp.concatenate([owner,
                                 jnp.where(lo_margin, lo_dest, owner)])
         live = jnp.concatenate([jnp.ones(n, bool), lo_margin])
-        return Route(dest, mesh), 2, live
+        route = Route(dest, mesh)
+        route.edges = edges
+        return route, 2, live
     if ghosts == 'both':
         if nproc == 2 and periodic:
             # the lower and upper neighbor are the SAME device: a
@@ -133,7 +201,9 @@ def slab_route(pos, box, rmax, mesh, ghosts='down', periodic=True):
                                 jnp.where(lo_margin, lo_dest, owner),
                                 jnp.where(hi_margin, hi_dest, owner)])
         live = jnp.concatenate([jnp.ones(n, bool), lo_margin, hi_margin])
-        return Route(dest, mesh), 3, live
+        route = Route(dest, mesh)
+        route.edges = edges
+        return route, 3, live
     raise ValueError("ghosts must be 'down', 'both' or None")
 
 

@@ -21,6 +21,8 @@ Sentinel caveat: the maximum representable key value is used as the
 padding sentinel; keys equal to it may be reordered among themselves.
 """
 
+import logging
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -29,34 +31,74 @@ from jax.sharding import PartitionSpec as P
 from .runtime import AXIS, mesh_size, shard_leading
 
 
+def sortable_key(k, reverse=False):
+    """Monotone map of a numeric column onto an unsigned-integer key:
+    any dtype then sorts as unsigned ints, and descending order is a
+    bit-flip. Floats use the IEEE order-preserving transform (negative
+    values get all bits flipped, positives get the sign bit set), so
+    NaNs land past +inf. Keys equal to the unsigned maximum collide
+    with :func:`dist_sort`'s padding sentinel (documented caveat)."""
+    k = jnp.asarray(k)
+    if k.dtype == jnp.bool_:
+        u = k.astype(jnp.uint8)
+    elif jnp.issubdtype(k.dtype, jnp.unsignedinteger):
+        u = k
+    elif jnp.issubdtype(k.dtype, jnp.integer):
+        nbits = jnp.iinfo(k.dtype).bits
+        udt = jnp.dtype('uint%d' % nbits)
+        u = jax.lax.bitcast_convert_type(k, udt) \
+            ^ udt.type(1 << (nbits - 1))
+    elif jnp.issubdtype(k.dtype, jnp.floating):
+        nbits = jnp.finfo(k.dtype).bits
+        udt = jnp.dtype('uint%d' % nbits)
+        b = jax.lax.bitcast_convert_type(k, udt)
+        neg = (b >> udt.type(nbits - 1)) != 0
+        u = jnp.where(neg, ~b, b | udt.type(1 << (nbits - 1)))
+    else:
+        raise TypeError("cannot build a sort key from dtype %s"
+                        % k.dtype)
+    return ~u if reverse else u
+
+
 def dist_sort(keys, values=None, mesh=None, slack=2.0):
-    """Globally sort ``keys`` (and optionally reorder ``values`` the
-    same way). Returns evenly re-sharded global arrays.
+    """Globally sort ``keys`` (and optionally reorder ``values`` — one
+    array or a list of arrays — the same way). Returns evenly
+    re-sharded global arrays: ``keys_sorted`` alone, ``(keys_sorted,
+    values_sorted)`` for a single payload, or ``(keys_sorted,
+    [values_sorted...])`` for a list.
+
+    The sort is STABLE (every internal argsort is stable and the
+    exchange/rebalance steps preserve source order among equal keys),
+    which multi-key LSD passes rely on (CatalogSource.sort).
     """
+    multi = isinstance(values, (list, tuple))
+    vlist = list(values) if multi else \
+        ([] if values is None else [values])
     nproc = mesh_size(mesh)
     if nproc == 1:
         dist_sort._last_dropped = 0
         order = jnp.argsort(keys)
+        outs = [v[order] for v in vlist]
         if values is None:
             return keys[order]
-        return keys[order], values[order]
+        return (keys[order], outs if multi else outs[0])
 
     N = keys.shape[0]
     npad = (-N) % nproc
     if jnp.issubdtype(keys.dtype, jnp.integer):
-        maxval = jnp.iinfo(keys.dtype).max
+        # keep the sentinel in the key dtype: a bare Python 2^64-1
+        # overflows JAX's weak int64 promotion for uint64 keys
+        maxval = jnp.asarray(jnp.iinfo(keys.dtype).max, keys.dtype)
     else:
         maxval = jnp.asarray(jnp.inf, keys.dtype)
     if npad:
         keys = jnp.concatenate(
             [keys, jnp.full(npad, maxval, keys.dtype)])
-        if values is not None:
-            values = jnp.concatenate(
-                [values, jnp.zeros((npad,) + values.shape[1:],
-                                   values.dtype)])
+        vlist = [jnp.concatenate(
+            [v, jnp.zeros((npad,) + v.shape[1:], v.dtype)])
+            for v in vlist]
     keys = shard_leading(mesh, keys)
-    if values is not None:
-        values = shard_leading(mesh, values)
+    vlist = [shard_leading(mesh, v) for v in vlist]
     nper = keys.shape[0] // nproc
     capacity = int(np.ceil(nper / nproc * slack)) + 16
 
@@ -132,7 +174,7 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
         dropped = jax.lax.psum(over1 + over2, AXIS)
         return tuple(outs) + (dropped,)
 
-    vals = () if values is None else (values,)
+    vals = tuple(vlist)
     in_specs = (P(AXIS),) + tuple(
         P(*((AXIS,) + (None,) * (v.ndim - 1))) for v in vals)
     out_specs = (P(AXIS),) + tuple(
@@ -150,6 +192,13 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
     cap_max = nper
     while dropped > 0 and capacity < cap_max:
         capacity = min(capacity * 4, cap_max)
+        # each retry retraces/recompiles and grows the receive buffer
+        # toward nproc*nper rows per device — surface the cost so a
+        # pathological key distribution is diagnosable
+        logging.getLogger('dist_sort').warning(
+            "dist_sort bucket overflow (%d rows dropped); retrying "
+            "with capacity=%d of max %d (recompiles the exchange)",
+            dropped, capacity, cap_max)
         res = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs)(keys, *vals)
         dropped = int(res[-1])
@@ -158,8 +207,7 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
         # unreachable in principle (capacity reaches nper); kept as a
         # correctness backstop: exact single-device fallback
         order = jnp.argsort(keys)
-        out = (keys[order],) if values is None else \
-            (keys[order], values[order])
+        out = (keys[order],) + tuple(v[order] for v in vals)
     else:
         out = res[:-1]
 
@@ -167,4 +215,4 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
         out = tuple(o[:N] for o in out)
     if values is None:
         return out[0]
-    return out[0], out[1]
+    return out[0], (list(out[1:]) if multi else out[1])

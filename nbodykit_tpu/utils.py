@@ -76,13 +76,32 @@ def json_object_hook(value):
         shape = tuple(value['__shape__'])
         data = value['__data__']
         if isinstance(dtype, list):  # structured
-            dtype = np.dtype([(str(n), str(t)) for n, t in
-                              (tuple(x) for x in dtype)])
-            arr = np.empty(shape, dtype=dtype)
-            for name in dtype.names:
-                arr[name] = json_object_hook(data[name]) \
-                    if isinstance(data[name], dict) else data[name]
-            return arr
+            fields = []
+            for f in (tuple(x) for x in dtype):
+                # reference files may carry (name, type, shape) triples
+                # (nbodykit/utils.py:441-448 accepts both arities)
+                if len(f) == 3:
+                    fields.append((str(f[0]), str(f[1]), tuple(f[2])))
+                else:
+                    fields.append((str(f[0]), str(f[1])))
+            dtype = np.dtype(fields)
+            if isinstance(data, dict):
+                # our column-oriented layout
+                arr = np.empty(shape, dtype=dtype)
+                for name in dtype.names:
+                    arr[name] = json_object_hook(data[name]) \
+                        if isinstance(data[name], dict) else data[name]
+                return arr
+            # reference row-oriented layout: nested lists down to the
+            # record level, each record a list of field values
+            # (written by nbodykit/utils.py JSONEncoder, decoded at
+            # utils.py:450-461) — np.array needs tuples at that level
+            def _rows_to_tuples(d, depth):
+                if depth > 0:
+                    return [_rows_to_tuples(i, depth - 1) for i in d]
+                return tuple(d)
+            return np.array(_rows_to_tuples(data, len(shape)),
+                            dtype=dtype)
         dt = np.dtype(str(dtype))
         if dt.kind == 'c':
             a = np.asarray(data, dtype='f8')

@@ -39,6 +39,43 @@ def test_fftpower_dk_zero_unique_edges():
     np.testing.assert_allclose(coords[1], 2 * np.pi / 8.0, rtol=1e-6)
 
 
+def test_find_unique_edges_complete_cubic():
+    # brute-force every |k| on the 20^3 hermitian lattice: the dk=0
+    # centers must hit EVERY unique modulus exactly (the round-2
+    # device-unique version silently truncated beyond 2^20 uniques)
+    from nbodykit_tpu.algorithms.fftpower import _find_unique_edges
+    pm = ParticleMesh(20, 10.0, dtype='f8', comm=cpu_mesh(1))
+    edges, fx = _find_unique_edges(pm, np.inf, kind='complex')
+    kf = 2 * np.pi / 10.0
+    ii = np.rint(np.fft.fftfreq(20, 1.0 / 20)).astype(int)
+    iz = np.arange(11)
+    isq = (ii[:, None, None] ** 2 + ii[None, :, None] ** 2
+           + iz[None, None, :] ** 2)
+    want = kf * np.sqrt(np.unique(isq).astype('f8'))
+    np.testing.assert_allclose(np.sort(fx), want, rtol=1e-12)
+    assert len(edges) == len(fx) + 1
+
+
+def test_find_unique_edges_anisotropic():
+    # anisotropic box: the fallback path must also enumerate all
+    # moduli (up to its documented 0.05*kf quantum)
+    from nbodykit_tpu.algorithms.fftpower import _find_unique_edges
+    pm = ParticleMesh(8, (8.0, 12.0, 20.0), dtype='f8',
+                      comm=cpu_mesh(1))
+    edges, fx = _find_unique_edges(pm, np.inf, kind='complex')
+    kf = 2 * np.pi / np.array([8.0, 12.0, 20.0])
+    ii = np.rint(np.fft.fftfreq(8, 1.0 / 8)).astype(int)
+    iz = np.arange(5)
+    k2 = ((kf[0] * ii[:, None, None]) ** 2
+          + (kf[1] * ii[None, :, None]) ** 2
+          + (kf[2] * iz[None, None, :]) ** 2)
+    quantum = kf.min() * 0.05
+    want_q = np.unique((np.sqrt(k2.ravel()) / quantum + 0.5)
+                       .astype('i8'))
+    got_q = np.unique((np.sort(fx) / quantum + 0.5).astype('i8'))
+    np.testing.assert_array_equal(got_q, want_q)
+
+
 def test_fftcorr_poles():
     rng = np.random.RandomState(3)
     field = rng.standard_normal((16, 16, 16))

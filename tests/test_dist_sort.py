@@ -50,6 +50,67 @@ def test_catalog_sort_multi_device():
     assert np.all(np.diff(m) >= 0)
 
 
+def test_dist_sort_multi_payload():
+    # a list payload: every array rides with its key
+    rng = np.random.RandomState(5)
+    keys = rng.randint(0, 1 << 20, 3000).astype(np.int64)
+    a = np.arange(3000, dtype=np.int64)
+    b = rng.standard_normal(3000)
+    ks, outs = dist_sort(jnp.asarray(keys),
+                         [jnp.asarray(a), jnp.asarray(b)], cpu_mesh())
+    order = np.argsort(keys, kind='stable')
+    np.testing.assert_array_equal(np.asarray(ks), keys[order])
+    np.testing.assert_array_equal(np.asarray(outs[0]), a[order])
+    np.testing.assert_allclose(np.asarray(outs[1]), b[order])
+
+
+def test_dist_sort_stability():
+    # many duplicate keys: payload order among equals must match the
+    # original order (the LSD multi-key passes depend on this)
+    rng = np.random.RandomState(6)
+    keys = rng.randint(0, 8, 4096).astype(np.int64)
+    tag = np.arange(4096, dtype=np.int64)
+    ks, tg = dist_sort(jnp.asarray(keys), jnp.asarray(tag), cpu_mesh())
+    order = np.argsort(keys, kind='stable')
+    np.testing.assert_array_equal(np.asarray(ks), keys[order])
+    np.testing.assert_array_equal(np.asarray(tg), tag[order])
+
+
+def test_sortable_key_orderings():
+    from nbodykit_tpu.parallel.sort import sortable_key
+    rng = np.random.RandomState(8)
+    for arr in [rng.standard_normal(512),
+                rng.standard_normal(512).astype('f4'),
+                rng.randint(-1000, 1000, 512),
+                rng.randint(0, 1 << 40, 512).astype(np.int64)]:
+        u = np.asarray(sortable_key(jnp.asarray(arr)))
+        np.testing.assert_array_equal(np.argsort(u, kind='stable'),
+                                      np.argsort(arr, kind='stable'))
+        r = np.asarray(sortable_key(jnp.asarray(arr), reverse=True))
+        np.testing.assert_array_equal(
+            np.asarray(arr)[np.argsort(r, kind='stable')],
+            np.sort(arr)[::-1])
+
+
+def test_catalog_sort_multikey_reverse_multi_device():
+    # multi-key + reverse on an 8-device mesh matches numpy lexsort
+    from nbodykit_tpu.lab import ArrayCatalog
+    from nbodykit_tpu.parallel.runtime import use_mesh
+    rng = np.random.RandomState(9)
+    a = rng.randint(0, 16, 4096).astype(np.int64)
+    b = rng.standard_normal(4096)
+    with use_mesh(cpu_mesh()):
+        cat = ArrayCatalog({'a': a, 'b': b})
+        s_fwd = cat.sort(['a', 'b'])
+        s_rev = cat.sort(['a', 'b'], reverse=True)
+    order = np.lexsort((b, a))
+    np.testing.assert_array_equal(np.asarray(s_fwd['a']), a[order])
+    np.testing.assert_allclose(np.asarray(s_fwd['b']), b[order])
+    np.testing.assert_array_equal(np.asarray(s_rev['a']),
+                                  a[order][::-1])
+    np.testing.assert_allclose(np.asarray(s_rev['b']), b[order][::-1])
+
+
 def test_dist_sort_fast_path_engages():
     # balanced input must take the distributed path (no fallback)
     rng = np.random.RandomState(7)

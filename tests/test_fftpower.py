@@ -179,18 +179,103 @@ def test_fftcorr_device_invariance():
                                rtol=1e-8, equal_nan=True)
 
 
+def _projected_power_oracle(field_np, boxsize, axes, dk, kmin=0.0):
+    """Independent numpy computation of the projected power."""
+    nd = len(axes)
+    dropped = tuple(i for i in range(3) if i not in axes)
+    proj = np.transpose(field_np.sum(axis=dropped),
+                        [sorted(axes).index(a) for a in axes])
+    c = np.fft.rfftn(proj) / field_np.size
+    pk = (c * c.conj())
+    pk.flat[0] = 0.0
+    dims = [field_np.shape[i] for i in axes]
+    lens = [boxsize] * nd
+    kk = np.zeros(pk.shape)
+    for j in range(nd):
+        freq = (np.arange(pk.shape[-1]) if j == nd - 1
+                else np.fft.fftfreq(dims[j], 1.0 / dims[j]))
+        sh = [1] * nd
+        sh[j] = freq.size
+        kk = kk + (freq * 2 * np.pi / lens[j]).reshape(sh) ** 2
+    kmag = np.sqrt(kk)
+    w = np.full(pk.shape, 2.0)
+    w[..., 0] = 1.0
+    if dims[-1] % 2 == 0:
+        w[..., -1] = 1.0
+    kedges = np.arange(kmin, np.pi * min(dims) / max(lens) + dk / 2, dk)
+    dig = np.digitize(kmag.reshape(-1), kedges)
+    nb = len(kedges) + 1
+    nsum = np.bincount(dig, weights=w.reshape(-1), minlength=nb)
+    psum = np.bincount(dig, weights=(w * pk.real).reshape(-1),
+                       minlength=nb)
+    with np.errstate(invalid='ignore', divide='ignore'):
+        return (psum / nsum)[1:-1] * np.prod(lens)
+
+
 def test_projected_fftpower(comm):
     rng = np.random.RandomState(11)
     field_np = rng.standard_normal((16, 16, 16))
     mesh = ArrayMesh(field_np, BoxSize=16.0, comm=comm)
     r = ProjectedFFTPower(mesh, axes=(0, 1))
     assert 'power' in r.power.variables
-    # oracle: project by averaging axis 2, 2d power of the map
-    proj = field_np.mean(axis=2)
-    c = np.fft.rfftn(proj) / proj.size
-    pk2 = np.abs(c) ** 2 * 16.0 ** 2
-    # total variance check via Parseval-ish sum (weak oracle)
-    assert np.isfinite(r.power['power'].real[1:]).all()
+    oracle = _projected_power_oracle(field_np, 16.0, (0, 1),
+                                     dk=2 * np.pi / 16.0)
+    np.testing.assert_allclose(r.power['power'].real, oracle,
+                               rtol=1e-8, equal_nan=True)
+
+
+def test_projected_fftpower_1d_axis(comm):
+    rng = np.random.RandomState(13)
+    field_np = rng.standard_normal((16, 16, 16))
+    mesh = ArrayMesh(field_np, BoxSize=16.0, comm=comm)
+    r = ProjectedFFTPower(mesh, axes=(2,))
+    oracle = _projected_power_oracle(field_np, 16.0, (2,),
+                                     dk=2 * np.pi / 16.0)
+    np.testing.assert_allclose(r.power['power'].real, oracle,
+                               rtol=1e-8, equal_nan=True)
+
+
+def test_project_to_basis_chunked_multidevice(monkeypatch):
+    # forcing tiny chunks on an 8-device mesh must reproduce the
+    # unchunked single-device result exactly (the chunked path now
+    # engages inside shard_map, round-2 VERDICT weak #4)
+    import nbodykit_tpu.algorithms.fftpower as fp
+    rng = np.random.RandomState(20)
+    field_np = rng.standard_normal((16, 16, 16))
+    r_one = FFTPower(ArrayMesh(field_np, BoxSize=16.0, comm=cpu_mesh(1)),
+                     mode='2d', Nmu=5, poles=[0, 2])
+    monkeypatch.setattr(fp, '_BIN_CHUNK_ELEMENTS', 16 * 9)
+    r_many = FFTPower(ArrayMesh(field_np, BoxSize=16.0, comm=cpu_mesh()),
+                      mode='2d', Nmu=5, poles=[0, 2])
+    np.testing.assert_allclose(r_one.power['power'].real,
+                               r_many.power['power'].real,
+                               rtol=1e-10, equal_nan=True)
+    np.testing.assert_allclose(r_one.poles['power_0'].real,
+                               r_many.poles['power_0'].real,
+                               rtol=1e-10, equal_nan=True)
+
+
+def test_project_to_basis_mxu_binning(monkeypatch):
+    # the MXU one-hot-matmul histogram is the production binning on
+    # TPU; force it on CPU and compare against the exact bincount path
+    import nbodykit_tpu.ops.histogram as hist
+    rng = np.random.RandomState(21)
+    field_np = rng.standard_normal((16, 16, 16))
+    r_exact = FFTPower(ArrayMesh(field_np, BoxSize=16.0), mode='2d',
+                       Nmu=5, poles=[0, 2, 4])
+    monkeypatch.setattr(hist, '_default_method', lambda: 'mxu')
+    r_mxu = FFTPower(ArrayMesh(field_np, BoxSize=16.0), mode='2d',
+                     Nmu=5, poles=[0, 2, 4])
+    np.testing.assert_allclose(r_mxu.power['power'].real,
+                               r_exact.power['power'].real,
+                               rtol=2e-5, equal_nan=True)
+    np.testing.assert_allclose(r_mxu.poles['power_2'].real,
+                               r_exact.poles['power_2'].real,
+                               atol=2e-5 * np.nanmax(
+                                   np.abs(r_exact.poles['power_2'].real)),
+                               equal_nan=True)
+    np.testing.assert_allclose(np.asarray(r_mxu.power['modes'], 'f8'),
+                               np.asarray(r_exact.power['modes'], 'f8'))
 
 
 def test_projected_fftpower_device_invariance():

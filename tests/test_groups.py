@@ -25,8 +25,11 @@ def test_cgm_basic():
     assert types[0] == 0            # central with satellites
     assert types[1] == 1 and hid[1] == 0
     assert types[2] == 1 and hid[2] == 0
-    assert types[3] == 2            # isolated
-    assert np.asarray(cgm.groups['num_cgm_sats'])[0] == 2
+    # isolated centrals are type 0 with no satellites (the reference
+    # defines only types 0/1, cgm.py:133-134)
+    assert types[3] == 0
+    nsat = np.asarray(cgm.groups['num_cgm_sats'])
+    assert nsat[0] == 2 and nsat[3] == 0
 
 
 def test_cgm_rank_ordering():
@@ -38,6 +41,27 @@ def test_cgm_rank_ordering():
     types = np.asarray(cgm.groups['cgm_type'])
     assert types[1] == 0 and types[0] == 1
     assert np.asarray(cgm.groups['cgm_haloid'])[0] == 1
+
+
+def test_cgm_overlapping_cylinders_highest_priority():
+    # a satellite whose cylinder contains TWO centrals joins the
+    # higher-priority (more massive) one, even though the other is
+    # nearer — the reference sorts candidate pairs by rank and keeps
+    # the first (cgm.py:150+), it does not pick the nearest
+    pos = np.array([
+        [50.0, 50.0, 50.0],   # central A, highest mass, dperp 0.9
+        [50.9, 50.0, 50.0],   # satellite, between the two centrals
+        [51.5, 50.0, 50.0],   # central B, lower mass, dperp 0.6
+    ])                        # A<->B 1.5 > rperp: both stay central
+    mass = np.array([10.0, 1.0, 5.0])
+    cat = ArrayCatalog({'Position': pos, 'Mass': mass}, BoxSize=100.0)
+    cgm = CylindricalGroups(cat, rankby='Mass', rperp=1.0, rpar=1.0)
+    types = np.asarray(cgm.groups['cgm_type'])
+    hid = np.asarray(cgm.groups['cgm_haloid'])
+    nsat = np.asarray(cgm.groups['num_cgm_sats'])
+    assert list(types) == [0, 1, 0]
+    assert hid[1] == 0              # joined A (priority), not B (near)
+    assert nsat[0] == 1 and nsat[2] == 0
 
 
 def test_fibercollisions_pair():

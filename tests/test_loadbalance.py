@@ -1,0 +1,87 @@
+"""Dynamic load balancing of the slab decomposition (the analog of the
+reference's ``domain.loadbalance`` re-tiling, fof.py:399,
+pair_counters/domain.py:256): clustered catalogs must spread evenly
+over devices and give device-count-invariant results."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from nbodykit_tpu.parallel.runtime import cpu_mesh, mesh_size
+from nbodykit_tpu.parallel.domain import (slab_route,
+                                          balanced_slab_edges)
+
+
+def _clustered_positions(n=4096, box=100.0, seed=3):
+    """~97% of particles inside one uniform-slab width of an 8-device
+    decomposition (dense blob, so linking/counting radii find pairs)."""
+    rng = np.random.RandomState(seed)
+    pos = rng.uniform(0, box, size=(n, 3))
+    nclust = int(n * 0.97)
+    pos[:nclust, 0] = rng.uniform(2.0, 9.0, size=nclust)  # slab 0 of 8
+    pos[:nclust, 1] = rng.uniform(0.0, 20.0, size=nclust)
+    pos[:nclust, 2] = rng.uniform(0.0, 20.0, size=nclust)
+    return pos
+
+
+def test_balanced_edges_even_counts():
+    box = 100.0
+    mesh = cpu_mesh()
+    nproc = mesh_size(mesh)
+    pos = _clustered_positions(box=box)
+    x = jnp.asarray(pos[:, 0])
+    edges = balanced_slab_edges(x, box, nproc, rmax=1.0)
+    assert edges[0] == 0 and edges[-1] == box
+    assert (np.diff(edges) >= 1.0 - 1e-9).all()  # min width respected
+    counts = np.histogram(pos[:, 0], bins=edges)[0]
+    even = len(pos) / nproc
+    assert counts.max() <= 2.0 * even, counts
+    # the uniform tiling would be catastrically skewed on this input
+    ucounts = np.histogram(pos[:, 0],
+                           bins=np.linspace(0, box, nproc + 1))[0]
+    assert ucounts.max() > 5.0 * even
+
+
+def test_balanced_route_bounded_capacity():
+    box = 100.0
+    mesh = cpu_mesh()
+    nproc = mesh_size(mesh)
+    pos = jnp.asarray(_clustered_positions(box=box))
+    route, f, live = slab_route(pos, box, 1.0, mesh, ghosts='both',
+                                balance=True)
+    dest = np.asarray(route.dest)
+    lv = np.asarray(live)
+    per_dev = np.bincount(dest[lv], minlength=nproc)
+    even = lv.sum() / nproc
+    assert per_dev.max() <= 2.5 * even, per_dev
+
+
+def test_fof_clustered_device_invariance():
+    from nbodykit_tpu.lab import ArrayCatalog
+    from nbodykit_tpu.algorithms.fof import FOF
+    from nbodykit_tpu.parallel.runtime import use_mesh
+    pos = _clustered_positions(n=2000)
+    sizes = []
+    for mesh in [cpu_mesh(1), cpu_mesh()]:
+        with use_mesh(mesh):
+            cat = ArrayCatalog({'Position': pos}, BoxSize=100.0)
+            f = FOF(cat, linking_length=1.0, nmin=5, absolute=True)
+            lab = np.asarray(f.labels)
+        # compare the sorted multiset of group sizes (labels may be
+        # numbered differently)
+        cnt = np.bincount(lab[lab > 0])
+        sizes.append(np.sort(cnt[cnt >= 5]))
+    np.testing.assert_array_equal(sizes[0], sizes[1])
+
+
+def test_paircount_clustered_device_invariance():
+    from nbodykit_tpu.algorithms.pair_counters.core import (
+        paircount, paircount_dist)
+    pos = jnp.asarray(_clustered_positions(n=1500))
+    redges = np.linspace(0.1, 3.0, 6)
+    ref = paircount(pos, None, pos, None, 100.0, redges, mode='1d')
+    got = paircount_dist(pos, None, pos, None, 100.0, redges,
+                         cpu_mesh(), mode='1d')
+    np.testing.assert_allclose(got['npairs'], ref['npairs'], rtol=1e-9)
+    np.testing.assert_allclose(got['wnpairs'], ref['wnpairs'],
+                               rtol=1e-9)
