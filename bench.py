@@ -39,6 +39,14 @@ itself was killed at budget; r02: the probe subprocess was killed at
   throughput estimates (Mpart/s, effective GB/s) are recorded in
   BENCH_DETAIL.json.
 
+Round-4 hardening: round 3's "measurement" was silently a CPU fallback
+(the tunnel was wedged at bench time and the worker's backend came up
+as platform='cpu'). Now every record carries its platform; a CPU
+fallback runs a reduced ladder and is never headlined as a TPU number;
+and every real-TPU config measured at ANY point during the round is
+merged into the committed BENCH_TPU_CACHE.json, which the orchestrator
+falls back to when the live run cannot reach the TPU.
+
 Subcommands (internal):
     bench.py --worker                 run the full ladder (imports jax)
     bench.py --config N NPART [m]     one fftpower config, JSON on stdout
@@ -54,8 +62,16 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 DETAIL_PATH = os.path.join(HERE, 'BENCH_DETAIL.json')
 WORKER_LOG = os.path.join(HERE, 'BENCH_WORKER.log')
+# Committed cache of the best REAL-TPU measurements ever taken: the
+# round-3 "result" was silently a CPU fallback (BENCH_DETAIL.json
+# probe.platform == 'cpu') because the tunnel was wedged at bench time.
+# Any TPU config measured at any point during a round lands here, so
+# the end-of-round bench can report it even if the tunnel is down then.
+TPU_CACHE_PATH = os.path.join(HERE, 'BENCH_TPU_CACHE.json')
 TOTAL_BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', 1500))
 NOMINAL_BASELINE_S = 30.0  # see module docstring
+
+TPU_PLATFORMS = ('tpu', 'axon')
 
 # v5e single-chip nominals for efficiency estimates
 V5E_HBM_GBPS = 819.0
@@ -75,6 +91,12 @@ def _setup_jax():
             os.environ.get('JAX_NUM_CPU_DEVICES', '0') or 0)
         if n > 1:
             jax.config.update('jax_num_cpu_devices', n)
+    # persistent compile cache: the ladder re-jits the same programs
+    # (and a re-run after a tunnel wedge should not pay compiles again);
+    # same dir + env override as __graft_entry__._enable_compile_cache
+    # so the dryrun/bench/test caches stay shared
+    import __graft_entry__
+    __graft_entry__._enable_compile_cache()
     return jax
 
 
@@ -114,7 +136,7 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
     import jax
     import jax.numpy as jnp
     from nbodykit_tpu.ops.window import compensation_transfer
-    from nbodykit_tpu.ops.histogram import hist2d_mxu
+    from nbodykit_tpu.ops.histogram import hist2d_weighted
 
     Nmesh = int(pm.Nmesh[0])
     L = float(pm.BoxSize[0])
@@ -125,8 +147,6 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
     # square) on a rounding-dependent side
     Nx = Nmesh // 2
     Nmu = 10
-    isq_edges = jnp.asarray((np.arange(Nx + 1, dtype='i8') ** 2)
-                            .astype('i4'))
     transfer = compensation_transfer(resampler, False)
     V = L ** 3
 
@@ -151,9 +171,14 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
                                        (rows,)).reshape(rows, 1, 1)
             isq = (ix_full * ix_full + iy * iy + iz_full * iz_full)
             wgt = jnp.broadcast_to(herm_z, sl.shape).reshape(-1)
-            dig_k = jnp.searchsorted(
-                isq_edges, jnp.broadcast_to(isq, sl.shape).reshape(-1),
-                side='right')
+            # k-bin = floor(sqrt(isq)) + 1 with exact integer
+            # correction of the f32 sqrt rounding (replaces a
+            # searchsorted binary search: one rsqrt + two integer
+            # compares per element instead of ~10 gather rounds)
+            r = jnp.sqrt(isq.astype(jnp.float32)).astype(jnp.int32)
+            r = r - (r * r > isq) + ((r + 1) * (r + 1) <= isq)
+            dig_k = jnp.minimum(r + 1, Nx + 1)
+            dig_k = jnp.broadcast_to(dig_k, sl.shape).reshape(-1)
             # exact integer mu binning (edges m/5, m=-5..5; mu >= 0 on
             # the half-spectrum): mu >= m/5  <=>  25*iz^2 >= m^2*isq.
             # Float mu is rounding-ambiguous exactly on the Pythagorean
@@ -163,12 +188,13 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
                          for m in range(1, Nmu // 2 + 1))
             dig_mu = jnp.where(isq == 0, 0, dig_mu) + (Nmu // 2 + 1)
             dig_mu = jnp.broadcast_to(dig_mu, sl.shape).reshape(-1)
-            # MXU one-hot-matmul histogram: ~5x faster than
-            # scatter-add bincount on TPU (see ops/histogram.py)
-            P_c, N_c = hist2d_mxu(dig_k, dig_mu,
-                                  [sl.reshape(-1) * wgt, wgt],
-                                  Nx + 2, Nmu + 2,
-                                  acc_dtype=jnp.float32)
+            # MXU one-hot-matmul histogram on TPU, scatter-add
+            # bincount elsewhere (the MXU path emulated on CPU is
+            # ~100x slower — the round-3 CPU-fallback trap)
+            P_c, N_c = hist2d_weighted(dig_k, dig_mu,
+                                       [sl.reshape(-1) * wgt, wgt],
+                                       Nx + 2, Nmu + 2,
+                                       acc_dtype=jnp.float32)
             return Psum + P_c, Nsum + N_c
 
         init = (jnp.zeros((Nx + 2, Nmu + 2), jnp.float32),
@@ -224,6 +250,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     rec = {
         "metric": "fftpower_wallclock_nmesh%d_npart%.0e" % (Nmesh, Npart),
         "unit": "s", "paint_method": method,
+        "platform": jax.devices()[0].platform,
+        "nmesh": Nmesh, "npart": Npart,
     }
     dt, compile_s = _time_fn(jax, jax.jit(fused), (pos,), reps)
     rec.update(value=round(dt, 4), compile_s=round(compile_s, 1),
@@ -286,6 +314,44 @@ def _flush_detail(detail):
     os.replace(tmp, DETAIL_PATH)
 
 
+def _cache_tpu_result(rec):
+    """Merge one real-TPU config record into the committed cache
+    (atomic; keyed by metric, latest wins)."""
+    if rec.get('platform') not in TPU_PLATFORMS:
+        return
+    try:
+        with open(TPU_CACHE_PATH) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        cache = {"results": {}}
+    rec = dict(rec)
+    rec['measured_at'] = time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                       time.gmtime())
+    cache['results'][rec['metric']] = rec
+    tmp = TPU_CACHE_PATH + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(cache, f, indent=1)
+    os.replace(tmp, TPU_CACHE_PATH)
+
+
+def _best_cached_tpu():
+    try:
+        with open(TPU_CACHE_PATH) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        return None
+    best = None
+    for rec in cache.get('results', {}).values():
+        if rec.get('value') and rec.get('value', -1) > 0:
+            # prefer the largest mesh (metric names sort by Nmesh
+            # numerically via the recorded nmesh field if present)
+            key = (rec.get('nmesh', 0), rec.get('npart', 0))
+            if best is None or key >= (best.get('nmesh', 0),
+                                       best.get('npart', 0)):
+                best = rec
+    return best
+
+
 def cmd_worker():
     detail = {"state": "starting", "t0": time.time(), "probe": None,
               "paint": [], "configs": [], "done": False}
@@ -334,14 +400,25 @@ def cmd_worker():
     # smallest-first ladder up to the north-star config; every step is
     # sized to finish (clean Python exceptions, e.g. OOM, do NOT wedge
     # the tunnel — only kills do, and nobody kills us)
-    ladder = [(128, 100_000), (256, 1_000_000), (512, 10_000_000),
-              (1024, 10_000_000), (1024, 100_000_000)]
+    on_tpu = detail['probe'].get('platform') in TPU_PLATFORMS
+    if on_tpu:
+        ladder = [(128, 100_000), (256, 1_000_000), (512, 10_000_000),
+                  (1024, 10_000_000), (1024, 100_000_000)]
+    else:
+        # CPU fallback (wedged tunnel): measure just enough to prove
+        # the harness works — a CPU ladder at Nmesh>=512 wastes the
+        # whole budget producing numbers we must not headline anyway
+        note("NOT on TPU (platform=%s) — reduced ladder, results "
+             "will be marked platform=cpu"
+             % detail['probe'].get('platform'))
+        ladder = [(128, 100_000), (256, 1_000_000)]
     for Nmesh, Npart in ladder:
         detail['state'] = 'config_nmesh%d_npart%.0e' % (Nmesh, Npart)
         _flush_detail(detail)
         try:
             res = run_config(Nmesh, Npart)
             detail['configs'].append(res)
+            _cache_tpu_result(res)
             note("ok: %s" % res)
         except Exception as e:
             detail['configs'].append({
@@ -364,10 +441,12 @@ def cmd_worker():
 # ---------------------------------------------------------------------------
 # orchestrator (no jax in this process; never kills anything)
 
-def _best_from_detail(detail):
+def _best_from_detail(detail, tpu_only=False):
     best = None
     for rec in detail.get('configs', []):
         if rec and rec.get('value', None) and rec.get('value', -1) > 0:
+            if tpu_only and rec.get('platform') not in TPU_PLATFORMS:
+                continue
             best = rec
     return best
 
@@ -404,15 +483,45 @@ def main():
     except (OSError, ValueError):
         state = {}
 
-    best = _best_from_detail(state)
+    # preference order: live TPU result > cached TPU result from
+    # earlier in the round > live CPU fallback (clearly marked) > -1
+    best = _best_from_detail(state, tpu_only=True)
     if best is not None:
         out = {k: best[k] for k in ("metric", "value", "unit",
                                     "vs_baseline")}
+        out['platform'] = best.get('platform')
         if not state.get('done'):
             out['note'] = ('budget elapsed at state=%s; worker left '
                            'running, larger configs may still land in '
                            'BENCH_DETAIL.json'
                            % state.get('state', '?'))
+        print(json.dumps(out))
+        return 0
+
+    cached = _best_cached_tpu()
+    if cached is not None:
+        out = {k: cached.get(k) for k in ("metric", "value", "unit",
+                                          "vs_baseline")}
+        out['platform'] = cached.get('platform')
+        out['note'] = ('live TPU run unavailable this invocation '
+                       '(worker state: %s); reporting the most recent '
+                       'real-TPU measurement, taken at %s UTC '
+                       '(BENCH_TPU_CACHE.json — possibly from an '
+                       'earlier round if the tunnel was down all of '
+                       'this one)'
+                       % (state.get('state', '?'),
+                          cached.get('measured_at')))
+        print(json.dumps(out))
+        return 0
+
+    best = _best_from_detail(state)
+    if best is not None:
+        out = {k: best[k] for k in ("metric", "value", "unit",
+                                    "vs_baseline")}
+        out['platform'] = best.get('platform')
+        out['note'] = ('CPU FALLBACK — the axon tunnel was wedged, so '
+                       'this is NOT a TPU number; do not compare '
+                       'against the baseline')
         print(json.dumps(out))
         return 0
 

@@ -53,6 +53,18 @@ def analytic_random_pairs(mode, edges, NR, BoxSize, Nmu=None,
         piedges = np.arange(0, int(pimax) + 1)
         vol = (np.pi * np.diff(edges ** 2)[:, None]
                * 2.0 * np.diff(piedges)[None, :])
+    elif mode == 'angular':
+        # spherical-cap ring area fraction (the reference's
+        # AnalyticUniformRandoms mode='angular',
+        # estimators.py:106-113). The exact cap area out to angular
+        # radius theta is 2*pi*(1 - cos(theta)), so the ring between
+        # consecutive theta edges (degrees) occupies the fraction
+        # (cos(theta_lo) - cos(theta_hi)) / 2 of the full sphere —
+        # exact at every opening angle (the reference's chord-based
+        # expression is a small-angle approximation that turns
+        # imaginary past 60 degrees).
+        frac = -0.5 * np.diff(np.cos(np.deg2rad(edges)))
+        return NR * (NR - 1) * frac
     else:
         raise ValueError("no analytic randoms for mode %r" % mode)
     return NR * (NR - 1) * vol / V

@@ -97,10 +97,8 @@ class SimulationBox2PCF(BasePairCount2PCF):
                                            second=data2, **kw)
 
         if randoms1 is None:
-            if not periodic:
+            if not periodic and mode != 'angular':
                 raise ValueError("need randoms for non-periodic data")
-            if mode == 'angular':
-                raise ValueError("no analytic randoms for angular mode")
             xi = natural_estimator(self.D1D2.pairs, mode, BoxSize,
                                    Nmu=Nmu, pimax=pimax)
             self.R1R2 = None

@@ -11,12 +11,26 @@ so work launched on disjoint device groups overlaps on hardware just as
 the reference's worker groups do across ranks; the thread pool plays
 the master role of the reference's READY/DONE tag loop.
 
+Multi-host (multi-slice) jobs: after
+:func:`~.parallel.runtime.init_distributed` every process sees the
+global device list; the groups are then formed along PROCESS
+boundaries (each group spans whole hosts) and a process only executes
+the tasks owned by its group — the analog of the reference farming
+worker sub-communicators across COMM_WORLD (batch.py:110-267). Task
+assignment is static round-robin (multi-controller jax has no
+cross-process tag channel; the reference's dynamic master-worker
+scheduling assumed one). Results are exchanged host-to-host with
+``jax.experimental.multihost_utils`` so ``map`` returns the full
+task-ordered result list on every process, exactly like the
+reference's terminal allgather (batch.py:343-346).
+
 API parity: ``with TaskManager(cpus_per_task) as tm:`` then
 ``tm.iterate(tasks)`` (serial generator on the first sub-mesh) or
 ``tm.map(func, tasks)`` (concurrent farming, results in task order).
 """
 
 import logging
+import pickle
 import queue
 from concurrent.futures import ThreadPoolExecutor
 
@@ -75,8 +89,125 @@ class TaskManager(object):
             or groups[:1]
         return [Mesh(np.array(g), (AXIS,)) for g in groups]
 
+    # -- multi-host farming -----------------------------------------------
+
+    def _process_groups(self):
+        """Partition the job's PROCESSES into task groups of
+        ``ceil(cpus_per_task / local_device_count)`` hosts each; every
+        group's devices form one sub-mesh spanning whole hosts (a
+        process cannot execute a program on a mesh that excludes its
+        own devices while including others')."""
+        import jax
+        from jax.sharding import Mesh
+        nproc = jax.process_count()
+        ndev_local = max(1, len(jax.local_devices()))
+        per = max(1, -(-self.cpus_per_task // ndev_local))
+        if self.use_all_cpus:
+            per = nproc
+        groups = []
+        for lo in range(0, nproc - per + 1, per):
+            procs = list(range(lo, lo + per))
+            devs = [d for d in jax.devices()
+                    if getattr(d, 'process_index', 0) in procs]
+            groups.append((procs, Mesh(np.array(devs), (AXIS,))))
+        if not groups:  # fewer processes than a single group needs
+            groups = [(list(range(nproc)),
+                       Mesh(np.array(jax.devices()), (AXIS,)))]
+        return groups
+
+    def _my_group(self, groups):
+        import jax
+        pid = jax.process_index()
+        for gi, (procs, mesh) in enumerate(groups):
+            if pid in procs:
+                return gi, procs, mesh
+        return None, [], None  # leftover host: idle worker
+
+    @staticmethod
+    def _exchange_results(local):
+        """Allgather a {tasknum: result} dict across processes via
+        pickled uint8 payloads (the reference's terminal
+        ``basecomm.allgather``, batch.py:343-346). Collective: every
+        process must call, idle ones with an empty dict."""
+        from jax.experimental import multihost_utils
+
+        payload = np.frombuffer(pickle.dumps(local), dtype=np.uint8)
+        n = np.array([payload.size], dtype=np.int64)
+        sizes = np.asarray(multihost_utils.process_allgather(n)) \
+            .reshape(-1)
+        cap = int(sizes.max())
+        padded = np.zeros(cap, dtype=np.uint8)
+        padded[:payload.size] = payload
+        gathered = np.asarray(
+            multihost_utils.process_allgather(padded, tiled=False))
+        gathered = gathered.reshape(len(sizes), cap)
+        merged = {}
+        for i, size in enumerate(sizes):
+            merged.update(pickle.loads(gathered[i, :int(size)]
+                                       .tobytes()))
+        return merged
+
+    @staticmethod
+    def _fetch_to_host(res, mesh):
+        """Convert jax.Array leaves of a task result to host numpy.
+        Arrays sharded over a multi-host group mesh are first
+        replicated ON that mesh (a collective all group processes
+        execute in lockstep) — a fully-replicated array is fetchable
+        on every host, where one with non-addressable shards is not."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def fetch(x):
+            if not isinstance(x, jax.Array):
+                return x
+            if not x.is_fully_addressable and not x.is_fully_replicated:
+                x = jax.jit(lambda a: a, out_shardings=NamedSharding(
+                    mesh, PartitionSpec()))(x)
+            return np.asarray(x)
+        return jax.tree.map(fetch, res)
+
+    def _map_multihost(self, function, tasks):
+        """Static round-robin farming across process groups; results
+        allgathered so every process returns the full ordered list."""
+        import jax
+        groups = getattr(self, '_mh_groups', None) \
+            or self._process_groups()
+        gi, procs, mesh = self._my_group(groups)
+        local = {}
+        for i, task in enumerate(tasks):
+            if gi is not None and i % len(groups) == gi:
+                with use_mesh(mesh):
+                    self.logger.debug(
+                        "task %d on process group %s", i, procs)
+                    res = self._fetch_to_host(function(task), mesh)
+                # only the group's first process publishes (results
+                # are replicated within a group, reference
+                # batch.py:340-341)
+                if jax.process_index() == procs[0]:
+                    local[i] = res
+        merged = self._exchange_results(local)
+        missing = [i for i in range(len(tasks)) if i not in merged]
+        if missing:
+            raise RuntimeError(
+                "multi-host task farming lost results for tasks %s"
+                % missing)
+        return [merged[i] for i in range(len(tasks))]
+
     def __enter__(self):
-        self._meshes = self._sub_meshes()
+        import jax
+        if jax.process_count() > 1:
+            # multi-host: the ambient mesh is THIS process's group
+            # mesh (a process must not enter a mesh excluding its own
+            # devices); an idle leftover host keeps its local devices
+            self._mh_groups = self._process_groups()
+            gi, _procs, mesh = self._my_group(self._mh_groups)
+            if mesh is None:
+                from jax.sharding import Mesh
+                mesh = Mesh(np.array(jax.local_devices()), (AXIS,))
+            self._meshes = [mesh]
+        else:
+            self._mh_groups = None
+            self._meshes = self._sub_meshes()
         self._ctx = use_mesh(self._meshes[0])
         self._ctx.__enter__()
         return self
@@ -88,7 +219,18 @@ class TaskManager(object):
 
     def iterate(self, tasks):
         """Iterate over tasks (reference batch.py:268); the ambient
-        mesh inside the loop is the first sub-mesh."""
+        mesh inside the loop is the first sub-mesh. In a multi-host
+        job each process group sees only its round-robin share, like
+        the reference's workers (batch.py:268-295)."""
+        import jax
+        if jax.process_count() > 1:
+            groups = getattr(self, '_mh_groups', None) \
+                or self._process_groups()
+            gi, _procs, _mesh = self._my_group(groups)
+            for i, task in enumerate(tasks):
+                if gi is not None and i % len(groups) == gi:
+                    yield task
+            return
         for task in tasks:
             yield task
 
@@ -97,7 +239,10 @@ class TaskManager(object):
         sub-meshes concurrently; results come back in task order
         (reference batch.py:297, whose master-worker loop also
         preserves ordering by index)."""
+        import jax
         tasks = list(tasks)
+        if jax.process_count() > 1:
+            return self._map_multihost(function, tasks)
         meshes = getattr(self, '_meshes', None) or self._sub_meshes()
         if len(meshes) <= 1 or len(tasks) <= 1:
             return [function(t) for t in tasks]

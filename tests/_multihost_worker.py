@@ -25,11 +25,14 @@ import jax.numpy as jnp  # noqa: E402
 
 def main():
     coord, nprocs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else 'pipeline'
     from nbodykit_tpu.parallel.runtime import init_distributed, \
         world_mesh
     if nprocs > 1:
         assert init_distributed(coordinator_address=coord,
                                 num_processes=nprocs, process_id=pid)
+    if mode == 'batch':
+        return main_batch()
     mesh = world_mesh()
     ndev = len(jax.devices())
 
@@ -54,6 +57,30 @@ def main():
     c = pm.r2c(field)
     p2 = float(jnp.sum(jnp.abs(c) ** 2))
     print("RESULT %d %.6e %.6e" % (ndev, total, p2), flush=True)
+
+
+def main_batch():
+    """Multi-host TaskManager farming: groups of one host each, five
+    tasks round-robined, every process must return the full ordered
+    result list (the reference's batch.py terminal allgather)."""
+    from nbodykit_tpu.batch import TaskManager
+    from nbodykit_tpu.pmesh import ParticleMesh
+    from nbodykit_tpu.parallel.runtime import CurrentMesh
+
+    def work(seed):
+        # a real sub-mesh pipeline: paint N particles on the group's
+        # own mesh and return the mass total (deterministic per seed)
+        mesh = CurrentMesh.get()
+        pm = ParticleMesh(Nmesh=8, BoxSize=10.0, dtype='f4', comm=mesh)
+        pos_np = np.random.RandomState(seed).uniform(0, 10.0, (257, 3))
+        pos = jnp.asarray(pos_np, jnp.float32)
+        field = pm.paint(pos, 1.0, resampler='cic')
+        return round(float(jnp.sum(field.astype(jnp.float32))), 3)
+
+    with TaskManager(cpus_per_task=4) as tm:
+        results = tm.map(work, list(range(11, 16)))
+    print("BATCHRESULT %s" % ",".join("%.3f" % r for r in results),
+          flush=True)
 
 
 if __name__ == '__main__':

@@ -92,7 +92,8 @@ def test_bench_pipeline_matches_fftpower():
 
     nbodykit_tpu.set_options(paint_method='scatter')
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=L, dtype='f4')
-    fn = jax.jit(bench._bench_fftpower_fn(pm, Npart))
+    fused, _phases = bench._bench_fftpower_fn(pm, slab_chunks=8)
+    fn = jax.jit(fused)
     Psum, Nsum = (np.asarray(x, 'f8') for x in fn(jnp.asarray(pos)))
     with np.errstate(invalid='ignore'):
         Pmu = Psum / Nsum
