@@ -145,9 +145,14 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
             iz = jnp.fft.fftfreq(N2, d=1.0 / N2).astype(
                 jnp.int32).reshape(1, 1, N2)
         x2fac = [ix * ix, iy * iy, iz * iz]  # int32, exact
+        # integer edge thresholds: for integer v, (e <= v) == (ceil(e)
+        # <= v), so digitizing int32 |i|^2 against ceil'd edges is
+        # FULLY exact — casting the f64 edges to f32 instead would let
+        # an edge within one ulp of an integer collapse onto the
+        # lattice and flip that boundary mode vs the f64 path
+        qe = np.ceil((np.asarray(xedges, dtype='f8') / unit) ** 2)
         x2edges = jnp.asarray(
-            (np.asarray(xedges, dtype='f8') / unit) ** 2,
-            dtype=jnp.float32)
+            np.clip(qe, 0, np.iinfo(np.int32).max).astype('i4'))
     else:
         unit = 1.0
         x2edges = jnp.asarray(np.asarray(xedges, dtype='f8') ** 2)
@@ -198,9 +203,9 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
         global row offset is ``start``."""
         x2 = sum(slice0(f, start) for f in x2fac)
         if exact_int:
-            # x2 is an exact int32 |i|^2; edges are pre-quantized
-            x2 = x2.astype(jnp.float32)
-            xnorm = unit * jnp.sqrt(x2)
+            # x2 stays int32 for the (exact) digitize; float only for
+            # the mean-|x| stream
+            xnorm = unit * jnp.sqrt(x2.astype(jnp.float32))
         else:
             xnorm = jnp.sqrt(x2)
         mudot = sum(slice0(c, start) for c in coords)

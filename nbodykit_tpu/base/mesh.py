@@ -219,6 +219,14 @@ class MeshSource(object):
 
     paint = compute
 
+    def preview(self, axes=None, Nmesh=None, root=0):
+        """Project the (optionally ``Nmesh``-downsampled) real field
+        onto ``axes`` and return host numpy (reference
+        base/mesh.py:340-383). ``root`` is accepted for signature
+        parity; global arrays make the result identical on every
+        process, so no broadcast is needed."""
+        return self.compute(mode='real', Nmesh=Nmesh).preview(axes=axes)
+
     def _resample(self, field, Nmesh):
         """Fourier-space resample to a new mesh size: mode truncation
         (down) or zero-padding (up), reference base/mesh.py:320-330."""

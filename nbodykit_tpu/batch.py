@@ -113,6 +113,14 @@ class TaskManager(object):
         if not groups:  # fewer processes than a single group needs
             groups = [(list(range(nproc)),
                        Mesh(np.array(jax.devices()), (AXIS,)))]
+        grouped = {p for procs, _ in groups for p in procs}
+        idle = sorted(set(range(nproc)) - grouped)
+        if idle:
+            # same situation the reference's split_ranks warns about:
+            # ranks that fit no full group sit out the whole session
+            self.logger.warning(
+                "%d process(es) %s do not fill a %d-host task group "
+                "and will be idle", len(idle), idle, per)
         return groups
 
     def _my_group(self, groups):

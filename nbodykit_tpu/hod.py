@@ -45,6 +45,167 @@ class Zheng07Model(object):
         return self.mean_ncen(M) * base ** p['alpha']
 
 
+class Leauthaud11Model(object):
+    """The Leauthaud et al. 2011 stellar-mass-threshold HOD
+    (arXiv:1103.2077 eqs. 2-8, built on the Behroozi et al. 2010
+    stellar-to-halo-mass relation, arXiv:1001.0015 eq. 21). The
+    reference exposes this model as a halotools factory
+    (``nbodykit/hod.py:191``); here the occupation functions are
+    implemented directly.
+
+    Centrals: the probability a halo of mass ``Mh`` hosts a galaxy
+    above the stellar threshold, a lognormal-scatter erf of the SHMR:
+
+        <Ncen>(Mh) = 1/2 [1 - erf((log10 m*_t - log10 f_SHMR(Mh))
+                                  / (sqrt(2) sigma_logM*))]
+
+    Satellites: a power law modulated by the central occupation:
+
+        <Nsat>(Mh) = <Ncen>(Mh) (Mh/Msat)^alpha exp(-Mcut/Mh)
+        Msat = 1e12 Bsat (Mh_t/1e12)^betasat,
+        Mcut = 1e12 Bcut (Mh_t/1e12)^betacut,  Mh_t = f_SHMR^-1(m*_t)
+
+    Defaults are the Leauthaud et al. 2012 SIG_MOD1 z~0.37 best fit
+    (the same values halotools ships as the 'leauthaud11' defaults).
+    Masses in Msun/h units; ``threshold`` is log10 of the stellar
+    threshold.
+    """
+
+    def __init__(self, threshold=10.5, smhm_m0=10.72, smhm_m1=12.35,
+                 smhm_beta=0.43, smhm_delta=0.56, smhm_gamma=1.54,
+                 scatter=0.2, alphasat=1.0, bsat=10.62, betasat=0.859,
+                 bcut=1.47, betacut=-0.13):
+        self.params = dict(
+            threshold=threshold, smhm_m0=smhm_m0, smhm_m1=smhm_m1,
+            smhm_beta=smhm_beta, smhm_delta=smhm_delta,
+            smhm_gamma=smhm_gamma, scatter=scatter, alphasat=alphasat,
+            bsat=bsat, betasat=betasat, bcut=bcut, betacut=betacut)
+        # Behroozi10 gives log10 Mh(m*) in closed form; tabulate it on
+        # a dense stellar-mass grid and interpolate the inverse
+        self._logms_grid = np.linspace(7.0, 12.8, 2048)
+        self._logmh_grid = self._log_mhalo(self._logms_grid)
+        p = self.params
+        self._log_mh_thresh = float(self._log_mhalo(
+            np.atleast_1d(p['threshold']))[0])
+        mh_t12 = 10.0 ** (self._log_mh_thresh - 12.0)
+        self._Msat = 1e12 * p['bsat'] * mh_t12 ** p['betasat']
+        self._Mcut = 1e12 * p['bcut'] * mh_t12 ** p['betacut']
+
+    def _log_mhalo(self, log_mstar):
+        """Behroozi et al. 2010 eq. 21: log10 Mh as a function of
+        log10 m* (the mean relation f_SHMR^-1)."""
+        p = self.params
+        r = 10.0 ** (log_mstar - p['smhm_m0'])  # m*/M*,0
+        return (p['smhm_m1'] + p['smhm_beta'] * (log_mstar - p['smhm_m0'])
+                + r ** p['smhm_delta'] / (1.0 + r ** (-p['smhm_gamma']))
+                - 0.5)
+
+    def _log_mstar(self, M):
+        """f_SHMR(Mh): numerical inverse of the (monotone) SHMR."""
+        logM = np.log10(np.clip(np.asarray(M, dtype='f8'), 1.0, None))
+        return np.interp(logM, self._logmh_grid, self._logms_grid)
+
+    def mean_ncen(self, M):
+        p = self.params
+        arg = (p['threshold'] - self._log_mstar(M)) \
+            / (np.sqrt(2.0) * p['scatter'])
+        return 0.5 * (1.0 - special.erf(arg))
+
+    def mean_nsat(self, M):
+        p = self.params
+        M = np.asarray(M, dtype='f8')
+        return (self.mean_ncen(M) * (M / self._Msat) ** p['alphasat']
+                * np.exp(-self._Mcut / np.clip(M, 1.0, None)))
+
+
+def _decorate(base, strength, percentile, split, upper=None):
+    """Decorated-HOD perturbation (Hearin et al. 2016,
+    arXiv:1512.03050): halos above the ``split`` percentile of the
+    secondary property get ``base + strength * dmax`` and those below
+    are compensated so the mass-binned mean is preserved exactly.
+    ``dmax`` is the largest upper-branch perturbation keeping BOTH
+    branches inside [0, upper] (the compensating lower-branch shift is
+    ``-dmax * (1-split)/split``, so its own floor/ceiling bounds dmax
+    too — without that, any split != 0.5 lets the clip break the
+    mean)."""
+    base = np.asarray(base, dtype='f8')
+    frac_hi = 1.0 - split
+    ratio = frac_hi / max(split, 1e-12)  # |delta_lo| = ratio*|delta_hi|
+    if upper is None:
+        up_room = np.inf
+    else:
+        up_room = upper - base
+    if strength >= 0:
+        # high branch rises (needs headroom), low branch falls
+        # (needs floor): delta_hi <= min(up_room, base/ratio)
+        dmax = np.minimum(up_room, base / max(ratio, 1e-12))
+    else:
+        # high branch falls, low branch rises
+        dmax = np.minimum(base, up_room / max(ratio, 1e-12))
+    delta_hi = strength * dmax
+    delta_lo = -delta_hi * ratio
+    out = np.where(np.asarray(percentile) >= split,
+                   base + delta_hi, base + delta_lo)
+    return np.clip(out, 0.0, upper)
+
+
+class Hearin15Model(Leauthaud11Model):
+    """Assembly-biased (decorated) Leauthaud11 HOD (Hearin & Watson
+    2015 / Hearin et al. 2016 decorated-HOD framework; the reference's
+    'hearin15' halotools factory, ``nbodykit/hod.py:192``): occupations
+    additionally depend on the halo's concentration percentile at
+    fixed mass. ``assembias_strength`` in [-1, 1] scales the maximal
+    mean-preserving perturbation for centrals
+    (``assembias_strength_sat`` for satellites, defaulting to the
+    same value); ``split`` is the percentile boundary."""
+
+    uses_assembly_bias = True
+
+    def __init__(self, threshold=10.5, split=0.5, assembias_strength=0.5,
+                 assembias_strength_sat=None, **kwargs):
+        super().__init__(threshold=threshold, **kwargs)
+        self.params.update(
+            split=split, assembias_strength=assembias_strength,
+            assembias_strength_sat=(
+                assembias_strength if assembias_strength_sat is None
+                else assembias_strength_sat))
+
+    def mean_ncen(self, M, percentile=None):
+        base = super().mean_ncen(M)
+        if percentile is None:
+            return base
+        p = self.params
+        return _decorate(base, p['assembias_strength'], percentile,
+                         p['split'], upper=1.0)
+
+    def mean_nsat(self, M, percentile=None):
+        base = super().mean_nsat(M)  # undecorated (percentile-free)
+        if percentile is None:
+            return base
+        p = self.params
+        return _decorate(base, p['assembias_strength_sat'], percentile,
+                         p['split'], upper=None)
+
+
+def mass_binned_percentile(M, secondary, nbins=20):
+    """Rank-percentile of ``secondary`` among halos of similar mass
+    (the conditioning variable of decorated-HOD assembly bias): log-M
+    is split into ``nbins`` equal-count bins and each halo gets its
+    secondary-property rank within its bin, in [0, 1)."""
+    M = np.asarray(M, dtype='f8')
+    sec = np.asarray(secondary, dtype='f8')
+    order = np.argsort(np.argsort(M, kind='stable'), kind='stable')
+    # equal-count mass bins via the rank of M
+    b = (order * nbins) // max(len(M), 1)
+    pct = np.zeros(len(M), dtype='f8')
+    for bi in np.unique(b):
+        sel = b == bi
+        r = np.argsort(np.argsort(sec[sel], kind='stable'),
+                       kind='stable')
+        pct[sel] = (r + 0.5) / sel.sum()
+    return pct
+
+
 def _sample_nfw_radius(key, conc, n):
     """Draw scaled NFW radii r/rvir by inverse-CDF interpolation:
     m(x) = ln(1+cx) - cx/(1+cx), normalized at x=1."""
@@ -97,13 +258,37 @@ class HODModel(object):
             rvir = as_numpy(halos['Radius'])
         except Exception:
             rvir = 0.3 * (M / 1e13) ** (1.0 / 3)
-        try:
-            conc = as_numpy(halos['Concentration'])
-        except Exception:
+        conc = None
+        if 'Concentration' in halos:
+            try:
+                conc = as_numpy(halos['Concentration'])
+            except Exception:
+                conc = None
+        has_conc = conc is not None
+        if conc is None:
+            # deterministic mass-scaling stand-in (NFW radii only —
+            # never fed to the assembly-bias percentile below)
             conc = 7.0 * (M / 1e13) ** -0.1
 
-        ncen_mean = self.occupation.mean_ncen(M)
-        nsat_mean = self.occupation.mean_nsat(M)
+        if getattr(self.occupation, 'uses_assembly_bias', False) \
+                and has_conc:
+            # decorated HOD: occupations also see the concentration
+            # percentile at fixed mass (only with a REAL secondary
+            # column — the deterministic mass-scaling fallback below
+            # would degenerate the percentile into a mass rank and
+            # fake an assembly-bias signal)
+            pct = mass_binned_percentile(M, conc)
+            ncen_mean = self.occupation.mean_ncen(M, percentile=pct)
+            nsat_mean = self.occupation.mean_nsat(M, percentile=pct)
+        else:
+            if getattr(self.occupation, 'uses_assembly_bias', False):
+                import warnings
+                warnings.warn(
+                    "assembly-biased occupation requested but the halo "
+                    "catalog has no 'Concentration' column; populating "
+                    "with the undecorated occupations")
+            ncen_mean = self.occupation.mean_ncen(M)
+            nsat_mean = self.occupation.mean_nsat(M)
 
         has_cen = np.asarray(
             jax.random.uniform(k_cen, (len(M),))) < ncen_mean

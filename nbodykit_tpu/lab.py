@@ -21,7 +21,9 @@ from .cosmology import (Cosmology, Planck13, Planck15,  # noqa: F401,E402
                         WMAP5, WMAP7, WMAP9, LinearPower, HalofitPower,
                         ZeldovichPower, CorrelationFunction)
 from .algorithms import ConvolvedFFTPower, FKPCatalog, FKPWeightFromNbar  # noqa: F401,E402
+FKPPower = ConvolvedFFTPower  # reference alias (algorithms/__init__.py:7)
 from .source.catalog.species import MultipleSpeciesCatalog  # noqa: F401,E402
+from .source.mesh.species import MultipleSpeciesCatalogMesh  # noqa: F401,E402
 from .source.catalog.file import (CSVCatalog, BinaryCatalog,  # noqa: F401,E402
                                   BigFileCatalog, HDFCatalog, FITSCatalog,
                                   TPMBinaryCatalog, Gadget1Catalog)
@@ -41,7 +43,8 @@ from .algorithms.cgm import CylindricalGroups  # noqa: F401,E402
 from .algorithms.fibercollisions import FiberCollisions  # noqa: F401,E402
 from . import filters  # noqa: F401,E402
 from .filters import TopHat, Gaussian  # noqa: F401,E402
-from .hod import HODModel, Zheng07Model, HODModelFactory  # noqa: F401,E402
+from .hod import (HODModel, Zheng07Model, Leauthaud11Model,  # noqa: F401,E402
+                  Hearin15Model, HODModelFactory)
 from .batch import TaskManager  # noqa: F401,E402
 from .source.catalog.subvolumes import SubVolumesCatalog  # noqa: F401,E402
 from .cosmology import FNLGalaxyPower, LinearNbody  # noqa: F401,E402

@@ -59,7 +59,7 @@ class DeviceGridHash(object):
     def __init__(self, pos, box, rmax, valid=None, periodic=True,
                  max_ncell=4096, axis_name=None):
         self.axis_name = axis_name
-        box = np.asarray(box, dtype='f8')
+        box = np.ones(int(pos.shape[-1])) * np.asarray(box, dtype='f8')
         ncell = np.maximum(np.floor(box / float(rmax)), 1).astype('i8')
         ncell = np.minimum(ncell, int(max_ncell))
         cellsize = box / ncell

@@ -59,7 +59,7 @@ class GridHash(object):
 
     def __init__(self, pos, box, rmax, periodic=True, max_ncell=128):
         pos = np.asarray(pos, dtype='f8')
-        box = np.asarray(box, dtype='f8')
+        box = np.ones(pos.shape[1]) * np.asarray(box, dtype='f8')
         ncell = np.maximum(np.floor(box / rmax), 1).astype('i8')
         ncell = np.minimum(ncell, max_ncell)
         cellsize = box / ncell

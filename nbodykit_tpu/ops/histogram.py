@@ -16,9 +16,11 @@ dozen, so the histogram is a *matrix product* that rides the MXU:
 All weight streams share one dot per chunk (their B-columns are
 concatenated), one-hots are exact in bfloat16, each weight is split
 into bf16 hi+lo parts (w = hi + lo), the MXU accumulates in f32 and
-chunk results are summed in f64 — measured max relative error ~2e-7
-and ~34 ms for 16.7M elements with 514x12 bins on v5e (vs ~340 ms for
-two bincounts).
+chunk results are summed in f64. Accuracy (~2e-7 max relative error
+vs exact f64 bincount) is asserted by tests/test_histogram.py; TPU
+timings for the containing FFTPower pipeline are recorded per-config
+in BENCH_TPU_CACHE.json (phases.binning_s), the single artifact perf
+claims should be read from.
 
 ``hist2d_weighted`` picks the MXU path on TPU and plain bincount
 elsewhere (CPU bincount is exact f64 and faster than emulated matmuls).

@@ -115,3 +115,26 @@ def test_value_column_weighting():
     f = mesh.to_real_field(normalize=False)
     np.testing.assert_allclose(float(f.value.sum()), vx.sum(),
                                rtol=1e-5)
+
+
+def test_meshsource_preview_downsample():
+    """MeshSource.preview(axes, Nmesh) gathers a downsampled
+    projection (reference base/mesh.py:340-383): projecting the
+    Nmesh-resampled field must equal resample-then-project."""
+    import jax.numpy as jnp
+    from nbodykit_tpu.lab import ArrayCatalog
+
+    rng = np.random.RandomState(3)
+    pos = rng.uniform(0, 100.0, (5000, 3))
+    mesh = ArrayCatalog({'Position': pos}, BoxSize=100.0).to_mesh(
+        Nmesh=16, resampler='cic', compensated=False)
+
+    full = mesh.preview(axes=(0, 1))
+    assert full.shape == (16, 16)
+    # total mass is preserved by projection
+    np.testing.assert_allclose(full.sum(), 16 ** 3, rtol=1e-4)
+
+    down = mesh.preview(axes=(0, 1), Nmesh=8)
+    assert down.shape == (8, 8)
+    want = mesh.compute(mode='real', Nmesh=8).preview(axes=(0, 1))
+    np.testing.assert_allclose(down, want, rtol=1e-6)

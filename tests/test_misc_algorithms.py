@@ -251,3 +251,91 @@ def test_task_manager_concurrent_submeshes(cpu8):
     # every ambient mesh had 2 devices; more than one distinct group ran
     assert all(len(d) == 2 for d in seen)
     assert len(set(seen)) > 1
+
+
+def test_leauthaud11_occupation():
+    """Native Leauthaud11 HOD (reference hod.py:191 exposes it via
+    halotools): erf midpoint at the SHMR threshold mass, monotone
+    occupations, satellite power law positive."""
+    from nbodykit_tpu.hod import Leauthaud11Model
+
+    m = Leauthaud11Model(threshold=10.5)
+    M = np.logspace(11, 15, 200)
+    ncen = m.mean_ncen(M)
+    nsat = m.mean_nsat(M)
+    assert np.all(np.diff(ncen) >= -1e-12) and ncen.max() <= 1.0
+    assert np.all(nsat >= 0) and nsat[-1] > 1.0
+    # <Ncen> = 1/2 exactly where f_SHMR(Mh) hits the threshold
+    Mh_t = 10 ** m._log_mh_thresh
+    np.testing.assert_allclose(m.mean_ncen(np.array([Mh_t]))[0], 0.5,
+                               atol=1e-4)
+    # SHMR grid inversion is self-consistent
+    np.testing.assert_allclose(m._log_mstar(np.array([Mh_t]))[0], 10.5,
+                               atol=1e-3)
+
+
+def test_hearin15_decorated_hod():
+    """Decorated HOD: the perturbation preserves the mass-binned mean,
+    respects bounds, and populate() runs end-to-end."""
+    from nbodykit_tpu.hod import (Hearin15Model, HODModel,
+                                  mass_binned_percentile)
+
+    M = np.full(1000, 1e13)
+    pct = np.linspace(0, 1, 1000, endpoint=False)
+    # mean preservation must hold at ANY split/strength, including the
+    # asymmetric cases where the compensating branch hits its floor
+    for split, strength in [(0.5, 0.8), (0.25, 1.0), (0.75, 1.0),
+                            (0.25, -1.0)]:
+        m = Hearin15Model(threshold=10.5, split=split,
+                          assembias_strength=strength)
+        ncen = m.mean_ncen(M, percentile=pct)
+        base = m.mean_ncen(M)
+        assert ncen.min() >= -1e-12 and ncen.max() <= 1.0 + 1e-12
+        np.testing.assert_allclose(ncen.mean(), base.mean(), rtol=1e-9,
+                                   err_msg="split=%s A=%s"
+                                   % (split, strength))
+        # and both branches actually moved (the decoration is active)
+        if abs(strength) > 0:
+            assert not np.allclose(ncen[pct >= split].mean(),
+                                   ncen[pct < split].mean())
+        nsat = m.mean_nsat(M, percentile=pct)
+        np.testing.assert_allclose(nsat.mean(), m.mean_nsat(M).mean(),
+                                   rtol=1e-9)
+        assert nsat.min() >= -1e-12
+
+    m = Hearin15Model(threshold=10.5, assembias_strength=0.8)
+    ncen = m.mean_ncen(M, percentile=pct)
+    base = m.mean_ncen(M)
+    # high-percentile halos are boosted
+    assert ncen[-1] > base[0] > ncen[0]
+
+    # percentiles are uniform within mass bins
+    rng = np.random.RandomState(2)
+    Mr = 10 ** rng.uniform(12, 15, 2000)
+    conc = 7.0 * (Mr / 1e13) ** -0.1 * rng.lognormal(0, 0.3, 2000)
+    p = mass_binned_percentile(Mr, conc)
+    assert 0.45 < p.mean() < 0.55 and p.min() >= 0 and p.max() < 1
+
+    # end-to-end population with assembly bias (real secondary column)
+    rng = np.random.RandomState(5)
+    nh = 400
+    halos = ArrayCatalog({
+        'Position': rng.uniform(0, 100.0, (nh, 3)),
+        'Velocity': np.zeros((nh, 3)),
+        'Mass': 10 ** rng.uniform(12.5, 14.5, nh),
+        'Concentration': rng.lognormal(2.0, 0.3, nh)}, BoxSize=100.0)
+    cat = HODModel(occupation=m, seed=11).populate(halos)
+    assert len(np.asarray(cat['Position'])) > 0
+    assert set(np.unique(np.asarray(cat['gal_type']))) <= {0, 1}
+
+    # without a Concentration column the decoration must NOT silently
+    # run on the deterministic mass-scaling fallback (it would fake an
+    # assembly-bias signal out of the mass rank); it warns and
+    # populates undecorated instead
+    bare = ArrayCatalog({
+        'Position': np.asarray(halos['Position']),
+        'Velocity': np.zeros((nh, 3)),
+        'Mass': np.asarray(halos['Mass'])}, BoxSize=100.0)
+    with pytest.warns(UserWarning, match="no 'Concentration'"):
+        cat2 = HODModel(occupation=m, seed=11).populate(bare)
+    assert len(np.asarray(cat2['Position'])) > 0
