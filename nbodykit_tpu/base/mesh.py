@@ -78,6 +78,8 @@ class Field(object):
         """Apply ``func(coords, value) -> value`` immediately with the
         coordinate arrays implied by ``kind`` (see
         :meth:`MeshSource.apply` for the deferred version)."""
+        if kind is None and isinstance(func, MeshFilter):
+            kind = func.kind
         if kind is None:
             kind = 'wavenumber' if self.kind == 'complex' else 'relative'
         coords = _coords_for(self.pm, self.kind, kind)
@@ -133,6 +135,24 @@ def _coords_for(pm, field_kind, coord_kind):
                          "(relative|index)" % coord_kind)
 
 
+class MeshFilter(object):
+    """Base class for named mesh filters (reference base/mesh.py
+    MeshFilter): subclasses declare the coordinate ``kind`` and field
+    ``mode`` they operate in and implement ``filter(coords, value)``;
+    instances can then be passed to :meth:`MeshSource.apply` /
+    :meth:`Field.apply` without repeating kind/mode at the call
+    site."""
+
+    kind = None
+    mode = None
+
+    def filter(self, coords, value):
+        raise NotImplementedError
+
+    def __call__(self, coords, value):
+        return self.filter(coords, value)
+
+
 class MeshSource(object):
     """Base class: a recipe for a distributed 3-D field.
 
@@ -161,8 +181,12 @@ class MeshSource(object):
         """Return a *view* of this mesh with ``func`` appended to the
         action queue (reference base/mesh.py:118-176). ``func`` takes
         ``(coords, value)`` and returns the new value; it runs on the
-        ``mode``-space field with ``kind`` coordinates."""
+        ``mode``-space field with ``kind`` coordinates. A
+        :class:`MeshFilter` instance carries its own kind/mode."""
         import copy
+        if isinstance(func, MeshFilter):
+            kind = func.kind if func.kind is not None else kind
+            mode = func.mode if func.mode is not None else mode
         view = copy.copy(self)
         view.attrs = self.attrs.copy()
         view._actions = self._actions + [(mode, func, kind)]

@@ -1,17 +1,26 @@
-"""Fourier-space mesh filters (reference: nbodykit/filters.py:5,35)."""
+"""Fourier-space mesh filters (reference: nbodykit/filters.py:5,35).
+
+Filters subclass :class:`~.base.mesh.MeshFilter` so ``mesh.apply(flt)``
+picks up the declared coordinate kind / field mode without the caller
+repeating them (reference filter protocol)."""
 
 import numpy as np
 import jax.numpy as jnp
 
+from .base.mesh import MeshFilter
 
-class TopHat(object):
+
+class TopHat(MeshFilter):
     """Spherical top-hat smoothing of radius r: multiplies delta_k by
     the Fourier window 3 (sin x - x cos x) / x^3, x = k r."""
+
+    kind = 'wavenumber'
+    mode = 'complex'
 
     def __init__(self, r):
         self.r = r
 
-    def __call__(self, k, v):
+    def filter(self, k, v):
         k2 = sum(ki ** 2 for ki in k)
         kr = jnp.sqrt(k2) * self.r
         krs = jnp.where(kr == 0, 1.0, kr)
@@ -20,13 +29,16 @@ class TopHat(object):
         return v * w
 
 
-class Gaussian(object):
+class Gaussian(MeshFilter):
     """Gaussian smoothing of width r: multiplies delta_k by
     exp(-(k r)^2 / 2)."""
+
+    kind = 'wavenumber'
+    mode = 'complex'
 
     def __init__(self, r):
         self.r = r
 
-    def __call__(self, k, v):
+    def filter(self, k, v):
         k2 = sum(ki ** 2 for ki in k)
         return v * jnp.exp(-0.5 * k2 * self.r ** 2)

@@ -16,6 +16,16 @@ from .source.catalog.array import ArrayCatalog
 from .utils import as_numpy
 
 
+class PopulatedHaloCatalog(ArrayCatalog):
+    """The galaxy catalog produced by HOD population (reference
+    source/catalog/halos.py PopulatedHaloCatalog): an ArrayCatalog
+    that remembers the ``model`` that made it."""
+
+    def __init__(self, data, model=None, comm=None, **attrs):
+        ArrayCatalog.__init__(self, data, comm=comm, **attrs)
+        self.model = model
+
+
 class Zheng07Model(object):
     """The 5-parameter Zheng07 HOD:
 
@@ -164,6 +174,17 @@ class Hearin15Model(Leauthaud11Model):
     def __init__(self, threshold=10.5, split=0.5, assembias_strength=0.5,
                  assembias_strength_sat=None, **kwargs):
         super().__init__(threshold=threshold, **kwargs)
+        for name, val in [('assembias_strength', assembias_strength),
+                          ('assembias_strength_sat',
+                           assembias_strength_sat)]:
+            if val is not None and not -1.0 <= val <= 1.0:
+                # beyond +-1 the perturbation exceeds the bound dmax
+                # was computed for and the clip would silently shift
+                # the mass-binned mean
+                raise ValueError("%s must lie in [-1, 1], got %r"
+                                 % (name, val))
+        if not 0.0 < split < 1.0:
+            raise ValueError("split must lie in (0, 1), got %r" % split)
         self.params.update(
             split=split, assembias_strength=assembias_strength,
             assembias_strength_sat=(
@@ -328,10 +349,10 @@ class HODModel(object):
             box = np.ones(3) * np.asarray(halos.attrs['BoxSize'])
             gal_pos = np.mod(gal_pos, box)
 
-        cat = ArrayCatalog(
+        cat = PopulatedHaloCatalog(
             {'Position': gal_pos, 'Velocity': gal_vel,
              'gal_type': gal_type, 'HaloMass': halo_mass},
-            comm=halos.comm, **halos.attrs)
+            model=self, comm=halos.comm, **halos.attrs)
         cat.attrs['seed'] = seed
         cat.attrs.update(self.occupation.params)
         return cat

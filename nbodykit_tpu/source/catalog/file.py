@@ -74,3 +74,12 @@ TPMBinaryCatalog = _make_file_catalog('TPMBinaryCatalog',
                                       _io.TPMBinaryFile, 'TPM snapshot')
 Gadget1Catalog = _make_file_catalog('Gadget1Catalog', _io.Gadget1File,
                                     'Gadget-1 snapshot')
+
+
+def FileCatalogFactory(name, filetype, examples=None):
+    """Create a CatalogSource class reading a custom
+    :class:`~nbodykit_tpu.io.base.FileType` subclass (reference
+    factory: nbodykit/source/catalog/file.py:232-238). ``examples`` is
+    accepted for signature parity and ignored."""
+    return _make_file_catalog(
+        name, filetype, getattr(filetype, '__name__', 'file'))

@@ -114,3 +114,9 @@ class HaloCatalog(CatalogSource):
 
     def to_mesh(self, *args, **kwargs):
         return CatalogSource.to_mesh(self, *args, **kwargs)
+
+
+# reference-path re-export: the reference defines PopulatedHaloCatalog
+# in this module (source/catalog/halos.py); the class itself lives with
+# the HOD machinery to avoid an import cycle
+from ...hod import PopulatedHaloCatalog  # noqa: F401,E402

@@ -131,3 +131,38 @@ class CatalogMesh(MeshSource):
 
     def to_mesh(self):
         return self
+
+
+# ---------------------------------------------------------------------------
+# Named compensation functions — the reference exposes these as public
+# apply-style kernels (nbodykit/source/mesh/catalog.py:380-470) that
+# users pass to ``mesh.apply(..., kind='circular', mode='complex')`` in
+# recipes. Each takes the circular frequencies ``w`` and the complex
+# field ``v`` and divides out the window transfer: the plain variants
+# use the Jing 2005 eq.20 first-order aliasing-corrected forms, the
+# *Shotnoise variants the pure sinc^p (eq.18) form.
+
+def _named_compensation(resampler, shotnoise):
+    transfer = compensation_transfer(resampler, interlaced=shotnoise)
+
+    def func(w, v):
+        return transfer(w, v)
+    return func
+
+
+CompensateCIC = _named_compensation('cic', False)
+CompensateTSC = _named_compensation('tsc', False)
+CompensatePCS = _named_compensation('pcs', False)
+CompensateCICShotnoise = _named_compensation('cic', True)
+CompensateTSCShotnoise = _named_compensation('tsc', True)
+CompensatePCSShotnoise = _named_compensation('pcs', True)
+
+for _f, _n in [(CompensateCIC, 'CompensateCIC'),
+               (CompensateTSC, 'CompensateTSC'),
+               (CompensatePCS, 'CompensatePCS'),
+               (CompensateCICShotnoise, 'CompensateCICShotnoise'),
+               (CompensateTSCShotnoise, 'CompensateTSCShotnoise'),
+               (CompensatePCSShotnoise, 'CompensatePCSShotnoise')]:
+    _f.__name__ = _n
+    _f.__qualname__ = _n
+del _f, _n
