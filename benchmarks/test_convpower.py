@@ -1,0 +1,27 @@
+"""ConvolvedFFTPower benchmark (reference
+benchmarks/test_convpower.py:7-25): FKP catalog with 10x randoms,
+poles [0, 2, 4], dk=0.005."""
+
+import numpy as np
+
+
+def test_convpower(sample, benchmark):
+    from nbodykit_tpu.lab import UniformCatalog
+    from nbodykit_tpu.algorithms.convpower import (FKPCatalog,
+                                                   ConvolvedFFTPower)
+
+    nbar = sample['N'] / sample['BoxSize'] ** 3
+    with benchmark('Data'):
+        data = UniformCatalog(nbar=nbar, BoxSize=sample['BoxSize'],
+                              seed=42)
+        randoms = UniformCatalog(nbar=10 * nbar,
+                                 BoxSize=sample['BoxSize'], seed=84)
+        data['NZ'] = nbar * np.ones(data.size)
+        randoms['NZ'] = nbar * np.ones(randoms.size)
+        fkp = FKPCatalog(data, randoms)
+        mesh = fkp.to_mesh(Nmesh=sample['Nmesh'], resampler='tsc')
+
+    with benchmark('Algorithm'):
+        r = ConvolvedFFTPower(mesh, poles=[0, 2, 4], dk=0.005)
+        assert np.isfinite(
+            np.asarray(r.poles['power_0'].real)).any()

@@ -1,0 +1,23 @@
+"""FFTPower benchmark (reference benchmarks/test_fftpower.py:7-19):
+LogNormalCatalog data phase + mode='2d' algorithm phase."""
+
+import numpy as np
+
+
+def test_fftpower(sample, benchmark):
+    from nbodykit_tpu.lab import (LogNormalCatalog, LinearPower,
+                                  FFTPower)
+    from nbodykit_tpu.cosmology import Planck15
+
+    with benchmark('Data'):
+        Plin = LinearPower(Planck15, redshift=0.55,
+                           transfer='EisensteinHu')
+        nbar = sample['N'] / sample['BoxSize'] ** 3
+        cat = LogNormalCatalog(Plin=Plin, nbar=nbar,
+                               BoxSize=sample['BoxSize'],
+                               Nmesh=sample['Nmesh'], bias=2.0, seed=42)
+
+    with benchmark('Algorithm'):
+        r = FFTPower(cat, mode='2d', Nmesh=sample['Nmesh'],
+                     kmin=0.001, Nmu=10)
+        assert np.isfinite(np.asarray(r.power['power'].real)).any()

@@ -1,0 +1,22 @@
+"""FOF benchmark (reference benchmarks/test_fof.py:7-26):
+linking_length=0.2, nmin=20, then find_features + to_halos."""
+
+import numpy as np
+
+
+def test_fof(sample, benchmark):
+    from nbodykit_tpu.lab import LogNormalCatalog, LinearPower, FOF
+    from nbodykit_tpu.cosmology import Planck15
+
+    with benchmark('Data'):
+        Plin = LinearPower(Planck15, redshift=0.55,
+                           transfer='EisensteinHu')
+        nbar = sample['N'] / sample['BoxSize'] ** 3
+        cat = LogNormalCatalog(Plin=Plin, nbar=nbar,
+                               BoxSize=sample['BoxSize'],
+                               Nmesh=sample['Nmesh'], bias=2.0, seed=42)
+
+    with benchmark('Algorithm'):
+        fof = FOF(cat, linking_length=0.2, nmin=20)
+        halos = fof.to_halos(1e12, Planck15, 0.0)
+        assert len(np.asarray(halos['Position'])) >= 0

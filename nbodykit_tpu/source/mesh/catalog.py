@@ -135,34 +135,27 @@ class CatalogMesh(MeshSource):
 
 # ---------------------------------------------------------------------------
 # Named compensation functions — the reference exposes these as public
-# apply-style kernels (nbodykit/source/mesh/catalog.py:380-470) that
+# apply-style kernels (nbodykit/source/mesh/catalog.py:453-585) that
 # users pass to ``mesh.apply(..., kind='circular', mode='complex')`` in
 # recipes. Each takes the circular frequencies ``w`` and the complex
-# field ``v`` and divides out the window transfer: the plain variants
-# use the Jing 2005 eq.20 first-order aliasing-corrected forms, the
-# *Shotnoise variants the pure sinc^p (eq.18) form.
+# field ``v`` and divides out the window transfer. Reference naming:
+# the PLAIN names are the pure Jing 2005 eq.18 sinc^p kernels (what
+# get_compensation selects when interlacing already removed aliasing),
+# and the *Shotnoise names are the eq.20 first-order
+# aliasing-corrected forms (selected when NOT interlaced).
 
-def _named_compensation(resampler, shotnoise):
-    transfer = compensation_transfer(resampler, interlaced=shotnoise)
-
-    def func(w, v):
-        return transfer(w, v)
+def _named_compensation(name, resampler, pure_sinc):
+    func = compensation_transfer(resampler, interlaced=pure_sinc)
+    func.__name__ = func.__qualname__ = name
     return func
 
 
-CompensateCIC = _named_compensation('cic', False)
-CompensateTSC = _named_compensation('tsc', False)
-CompensatePCS = _named_compensation('pcs', False)
-CompensateCICShotnoise = _named_compensation('cic', True)
-CompensateTSCShotnoise = _named_compensation('tsc', True)
-CompensatePCSShotnoise = _named_compensation('pcs', True)
-
-for _f, _n in [(CompensateCIC, 'CompensateCIC'),
-               (CompensateTSC, 'CompensateTSC'),
-               (CompensatePCS, 'CompensatePCS'),
-               (CompensateCICShotnoise, 'CompensateCICShotnoise'),
-               (CompensateTSCShotnoise, 'CompensateTSCShotnoise'),
-               (CompensatePCSShotnoise, 'CompensatePCSShotnoise')]:
-    _f.__name__ = _n
-    _f.__qualname__ = _n
-del _f, _n
+CompensateCIC = _named_compensation('CompensateCIC', 'cic', True)
+CompensateTSC = _named_compensation('CompensateTSC', 'tsc', True)
+CompensatePCS = _named_compensation('CompensatePCS', 'pcs', True)
+CompensateCICShotnoise = _named_compensation(
+    'CompensateCICShotnoise', 'cic', False)
+CompensateTSCShotnoise = _named_compensation(
+    'CompensateTSCShotnoise', 'tsc', False)
+CompensatePCSShotnoise = _named_compensation(
+    'CompensatePCSShotnoise', 'pcs', False)

@@ -222,3 +222,33 @@ def test_convpower_legacy_load(tmp_path):
     r = ConvolvedFFTPower.load(fn, format='pre000305')
     np.testing.assert_allclose(r.poles['power_0'].real, [100, 50, 25])
     assert r.attrs['shotnoise'] == 12.0
+
+
+@pytest.mark.slow
+def test_fftcorr_matches_paircount_xi():
+    """Cross-implementation oracle (SURVEY §4): xi(r) measured two
+    fully independent ways — FFT of the painted/compensated mesh
+    (FFTCorr) vs direct pair counting with analytic randoms
+    (SimulationBox2PCF natural estimator) — must agree on a clustered
+    lognormal realization. Measured agreement is 2-3% across
+    6 < r < 27 (mesh cell 1.95); tolerance 8%."""
+    from nbodykit_tpu.lab import LogNormalCatalog, LinearPower
+    from nbodykit_tpu.algorithms.fftcorr import FFTCorr
+    from nbodykit_tpu.algorithms.paircount_tpcf import SimulationBox2PCF
+    from nbodykit_tpu.cosmology import Planck15
+
+    Plin = LinearPower(Planck15, redshift=0.55, transfer='EisensteinHu')
+    box, nmesh = 250.0, 128
+    cat = LogNormalCatalog(Plin=Plin, nbar=1.5e-3, BoxSize=box,
+                           Nmesh=nmesh, bias=2.0, seed=9)
+
+    edges = np.linspace(6.0, 30.0, 9)
+    xi_pc = np.asarray(SimulationBox2PCF('1d', cat, edges).corr['corr'])
+
+    mesh = cat.to_mesh(Nmesh=nmesh, resampler='tsc', compensated=True)
+    rc = FFTCorr(mesh, mode='1d', rmin=6.0, dr=3.0, rmax=30.0)
+    xi_fft = np.asarray(rc.corr['corr'].real)
+
+    n = len(xi_fft)
+    np.testing.assert_allclose(xi_fft, xi_pc[:n], rtol=0.08)
+    assert xi_pc[0] > 1.0  # genuinely clustered sample

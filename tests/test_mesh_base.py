@@ -148,7 +148,8 @@ def test_meshfilter_protocol_and_compensations():
     from nbodykit_tpu.lab import ArrayCatalog
     from nbodykit_tpu.filters import Gaussian, TopHat
     from nbodykit_tpu.base.mesh import MeshFilter
-    from nbodykit_tpu.source.mesh.catalog import CompensateTSC
+    from nbodykit_tpu.source.mesh.catalog import (CompensateTSC,
+                                                  CompensateTSCShotnoise)
 
     assert isinstance(Gaussian(2.0), MeshFilter)
     rng = np.random.RandomState(7)
@@ -164,12 +165,23 @@ def test_meshfilter_protocol_and_compensations():
     th = np.asarray(mesh.apply(TopHat(5.0)).compute(mode='real').value)
     np.testing.assert_allclose(th.mean(), raw.mean(), rtol=1e-4)
 
-    # manual CompensateTSC == compensated=True
+    # reference naming: the non-interlaced compensated=True pipeline
+    # uses the *Shotnoise (eq.20) kernel (get_compensation,
+    # nbodykit/source/mesh/catalog.py:436-451), while the PLAIN name is
+    # the pure sinc^p (eq.18) kernel used under interlacing
     m1 = cat.to_mesh(Nmesh=16, resampler='tsc', compensated=True)
     m2 = cat.to_mesh(Nmesh=16, resampler='tsc', compensated=False) \
-        .apply(CompensateTSC, kind='circular', mode='complex')
+        .apply(CompensateTSCShotnoise, kind='circular', mode='complex')
     np.testing.assert_allclose(np.asarray(m1.compute(mode='real').value),
                                np.asarray(m2.compute(mode='real').value),
+                               rtol=1e-5, atol=1e-8)
+    m3 = cat.to_mesh(Nmesh=16, resampler='tsc', compensated=True,
+                     interlaced=True)
+    m4 = cat.to_mesh(Nmesh=16, resampler='tsc', compensated=False,
+                     interlaced=True) \
+        .apply(CompensateTSC, kind='circular', mode='complex')
+    np.testing.assert_allclose(np.asarray(m3.compute(mode='real').value),
+                               np.asarray(m4.compute(mode='real').value),
                                rtol=1e-5, atol=1e-8)
 
 
