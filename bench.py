@@ -409,13 +409,18 @@ def cmd_worker():
         ladder = [(128, 100_000), (256, 1_000_000), (512, 10_000_000),
                   (1024, 10_000_000), (1024, 100_000_000)]
     else:
-        # CPU fallback (wedged tunnel): measure just enough to prove
-        # the harness works — a CPU ladder at Nmesh>=512 wastes the
-        # whole budget producing numbers we must not headline anyway
-        note("NOT on TPU (platform=%s) — reduced ladder, results "
-             "will be marked platform=cpu"
+        # CPU fallback (wedged tunnel): clearly-marked scale proof.
+        # With the integer-bin histogram rewrite the full Nmesh=1024
+        # pipeline takes ~40 s on one core (docs/PERF.md). The ladder
+        # stops at 1e7 particles: the 1e8 north-star rung adds only
+        # paint time on a platform whose numbers are not comparable
+        # anyway, and TWO workers (this one + the forced-CPU sibling)
+        # may be walking this ladder concurrently on one host.
+        note("NOT on TPU (platform=%s) — CPU scale-proof ladder, "
+             "results will be marked platform=cpu"
              % detail['probe'].get('platform'))
-        ladder = [(128, 100_000), (256, 1_000_000)]
+        ladder = [(128, 100_000), (256, 1_000_000), (512, 1_000_000),
+                  (1024, 10_000_000)]
     for Nmesh, Npart in ladder:
         detail['state'] = 'config_nmesh%d_npart%.0e' % (Nmesh, Npart)
         _flush_detail(detail)

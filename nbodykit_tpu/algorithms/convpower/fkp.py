@@ -211,26 +211,26 @@ class ConvolvedFFTPower(object):
         else:
             norm = 1.0
 
-        # absolute-coordinate unit vectors on the mesh: cell centers
-        # shifted back to survey coordinates
+        # coordinate AXIS VECTORS only (a few KB): the full-mesh unit
+        # vectors x/|x| and k/|k| are formed INSIDE the jitted
+        # per-multipole program below, where XLA fuses them into the
+        # Ylm weights. Building them eagerly here (as before round 4)
+        # materialized six full-mesh f64 arrays and then baked them —
+        # plus the density field — into every per-ell executable as
+        # constants: ~35 GB of duplicated buffers at Nmesh=1024, the
+        # OOM observed in the boss_like benchmark, and a guaranteed
+        # HBM blow-up on a 16 GB TPU chip.
         N0, N1, N2 = pm.shape_real
         H = pm.cellsize
         offset = self.attrs['BoxCenter'] - pm.BoxSize / 2.0 + 0.5 * H
 
-        xh = [(jnp.arange(N0, dtype=jnp.float64) * H[0]
-               + offset[0]).reshape(N0, 1, 1),
-              (jnp.arange(N1, dtype=jnp.float64) * H[1]
-               + offset[1]).reshape(1, N1, 1),
-              (jnp.arange(N2, dtype=jnp.float64) * H[2]
-               + offset[2]).reshape(1, 1, N2)]
-        xnorm = jnp.sqrt(sum(x ** 2 for x in xh))
-        xnorm = jnp.where(xnorm == 0, 1.0, xnorm)
-        xh = [x / xnorm for x in xh]
-
-        kx, ky, kz = pm.k_list(dtype=jnp.float64, full=use_c2c)
-        knorm = jnp.sqrt(kx ** 2 + ky ** 2 + kz ** 2)
-        knorm = jnp.where(knorm == 0, jnp.inf, knorm)
-        kh = [kx / knorm, ky / knorm, kz / knorm]
+        xvec = [(jnp.arange(N0, dtype=jnp.float64) * H[0]
+                 + offset[0]).reshape(N0, 1, 1),
+                (jnp.arange(N1, dtype=jnp.float64) * H[1]
+                 + offset[1]).reshape(1, N1, 1),
+                (jnp.arange(N2, dtype=jnp.float64) * H[2]
+                 + offset[2]).reshape(1, 1, N2)]
+        kvec = pm.k_list(dtype=jnp.float64, full=use_c2c)
 
         cols = ['k'] + ['power_%d' % l for l in
                         sorted(self.attrs['poles'])] + ['modes']
@@ -246,24 +246,32 @@ class ConvolvedFFTPower(object):
                   (int(pm.Nmesh[1]), int(pm.Nmesh[0]),
                    int(pm.Nmesh[2])))
 
-        def ell_term(ell):
-            """Aell = sum_m FFT[F * Ylm(xh)] * Ylm(kh), compensated,
-            * 4pi * volume — one jitted program per ell."""
-            Aell = jnp.zeros(cshape, dtype=A0_1.dtype)
-            for m in range(-ell, ell + 1):
-                Ylm = get_real_Ylm(ell, m)
-                wx = Ylm(xh[0], xh[1], xh[2])
-                r = density2 * wx.astype(density2.dtype)
-                ck = forward(r)
-                wk = Ylm(kh[0], kh[1], kh[2])
-                Aell = Aell + ck * wk
-            Aell = transfer(w_circ, Aell)
-            return Aell * (4 * np.pi * volume)
+        def make_ell_term(ell):
+            """Aell = sum_m FFT[F * Ylm(x/|x|)] * Ylm(k/|k|),
+            compensated, * 4pi * volume — one jitted program per ell.
+            The density is a real argument (not a baked constant) and
+            the unit-vector meshes are fused into the Ylm weights."""
+            def prog(dens):
+                xn = jnp.sqrt(sum(x * x for x in xvec))
+                xn = jnp.where(xn == 0, 1.0, xn)
+                xu = [x / xn for x in xvec]
+                kn = jnp.sqrt(sum(k * k for k in kvec))
+                kn = jnp.where(kn == 0, jnp.inf, kn)
+                ku = [k / kn for k in kvec]
+                Aell = jnp.zeros(cshape, dtype=A0_1.dtype)
+                for m in range(-ell, ell + 1):
+                    Ylm = get_real_Ylm(ell, m)
+                    wx = Ylm(xu[0], xu[1], xu[2])
+                    ck = forward(dens * wx.astype(dens.dtype))
+                    Aell = Aell + ck * Ylm(ku[0], ku[1], ku[2])
+                Aell = transfer(w_circ, Aell)
+                return Aell * (4 * np.pi * volume)
+            return jax.jit(prog)
 
         proj_result = None
         for ell in poles[1:]:
             t0 = time.time()
-            Aell = jax.jit(ell_term, static_argnums=0)(ell)
+            Aell = make_ell_term(ell)(density2)
             p3d = norm * A0_1 * jnp.conj(Aell)
             field = Field(p3d, pm, 'complex')
             proj, _ = project_to_basis(field, [kedges, muedges])
