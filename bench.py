@@ -265,16 +265,24 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         "platform": jax.devices()[0].platform,
         "nmesh": Nmesh, "npart": Npart,
     }
-    try:
-        dt, compile_s = _time_fn(jax, jax.jit(fused), (pos,), reps)
-        rec['mode'] = 'fused'
-    except Exception as e:
-        # the axon remote-compile helper rejects the fused program at
-        # Nmesh>=512 (HTTP 500, subprocess exit 1 — compile-side
-        # memory); the three stages compile fine separately, and the
-        # intermediates never leave the device
-        if 'remote_compile' not in str(e) and 'RESOURCE' not in str(e):
-            raise
+    # the axon remote-compile helper dies on the fused program at
+    # Nmesh>=512 (HTTP 500 / subprocess exit 1, and the dead helper
+    # then hangs every later compile RPC for ~27 min before
+    # UNAVAILABLE) — go staged directly there; the three stages
+    # compile fine separately and the intermediates never leave the
+    # device
+    staged = (rec['platform'] in TPU_PLATFORMS and Nmesh >= 512)
+    if not staged:
+        try:
+            dt, compile_s = _time_fn(jax, jax.jit(fused), (pos,), reps)
+            rec['mode'] = 'fused'
+        except Exception as e:
+            if not any(s in str(e) for s in
+                       ('remote_compile', 'RESOURCE', 'UNAVAILABLE',
+                        'INTERNAL')):
+                raise
+            staged = True
+    if staged:
         rec['mode'] = 'staged'
         s_paint = jax.jit(lambda p: phase_fns['paint'](p)
                           / (Npart / pm.Ntot))

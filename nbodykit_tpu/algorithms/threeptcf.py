@@ -287,3 +287,52 @@ class SurveyData3PCF(Base3PCF):
             np.ones(len(pos))
         self.poles = self._run(pos, w, edges, poles, BoxSize=None,
                                periodic=False)
+
+
+class YlmCache(object):
+    """Complex spherical harmonics :math:`Y_{\\ell m}` up to a maximum
+    :math:`\\ell`, evaluated on Cartesian unit vectors.
+
+    API-compatible with the reference's sympy-backed cache
+    (reference threeptcf.py:393-505): ``YlmCache(ells)(xpyhat, zhat)``
+    — ``xpyhat`` the complex :math:`\\hat x + i \\hat y` — returns
+    ``{(l, m): complex array}`` for ``m`` in ``0..l``. Here each
+    harmonic is assembled from the closed-form real harmonics of
+    :func:`..convpower.fkp.get_real_Ylm` via
+
+    .. math:: Y_\\ell^m = \\frac{1}{\\sqrt 2}
+              (Y_{\\ell m}^{\\rm real} + i\\, Y_{\\ell,-m}^{\\rm real})
+
+    for :math:`m > 0` (and :math:`Y_\\ell^0 = Y_{\\ell 0}^{\\rm real}`),
+    so no symbolic algebra or code generation is needed.
+    """
+
+    def __init__(self, ells, comm=None):
+        self.ells = np.asarray(ells).astype(int)
+        self.max_ell = int(self.ells.max())
+        self.ell_to_iell = np.empty(self.max_ell + 1, dtype=int)
+        for iell, ell in enumerate(self.ells):
+            self.ell_to_iell[ell] = iell
+        self._fns = {}
+        for ell in self.ells:
+            for m in range(0, ell + 1):
+                fp = get_real_Ylm(ell, m)
+                if m == 0:
+                    self._fns[(ell, m)] = (fp, None)
+                else:
+                    self._fns[(ell, m)] = (fp, get_real_Ylm(ell, -m))
+
+    def __call__(self, xpyhat, zhat):
+        import math
+        xhat, yhat = np.real(xpyhat), np.imag(xpyhat)
+        toret = {}
+        for (ell, m), (fp, fm) in self._fns.items():
+            if fm is None:
+                toret[(ell, m)] = fp(xhat, yhat, zhat)
+            else:
+                # the Condon-Shortley phase already lives in the real
+                # harmonics' Legendre recurrence, so no extra (-1)^m
+                s = 1.0 / math.sqrt(2.0)
+                toret[(ell, m)] = s * (fp(xhat, yhat, zhat)
+                                       + 1j * fm(xhat, yhat, zhat))
+        return toret

@@ -13,7 +13,7 @@ from .base.mesh import MeshSource, FieldMesh  # noqa: F401
 from .source.catalog import ArrayCatalog, RandomCatalog, UniformCatalog  # noqa: F401
 from .source.mesh import CatalogMesh, LinearMesh, ArrayMesh  # noqa: F401
 from .algorithms import (FFTPower, ProjectedFFTPower, FFTCorr,  # noqa: F401
-                         project_to_basis)
+                         FFTBase, project_to_basis)
 from . import transform  # noqa: F401
 from .source.catalog import LogNormalCatalog  # noqa: F401,E402
 from . import cosmology  # noqa: F401,E402
@@ -21,22 +21,29 @@ from .cosmology import (Cosmology, Planck13, Planck15,  # noqa: F401,E402
                         WMAP5, WMAP7, WMAP9, LinearPower, HalofitPower,
                         ZeldovichPower, CorrelationFunction)
 from .algorithms import ConvolvedFFTPower, FKPCatalog, FKPWeightFromNbar  # noqa: F401,E402
+from .algorithms.convpower.catalogmesh import FKPCatalogMesh  # noqa: F401,E402
 FKPPower = ConvolvedFFTPower  # reference alias (algorithms/__init__.py:7)
 from .source.catalog.species import MultipleSpeciesCatalog  # noqa: F401,E402
 from .source.mesh.species import MultipleSpeciesCatalogMesh  # noqa: F401,E402
 from .source.catalog.file import (CSVCatalog, BinaryCatalog,  # noqa: F401,E402
                                   BigFileCatalog, HDFCatalog, FITSCatalog,
-                                  TPMBinaryCatalog, Gadget1Catalog)
+                                  TPMBinaryCatalog, Gadget1Catalog,
+                                  FileCatalogBase, FileCatalog,
+                                  FileCatalogFactory)
 from .source.mesh.bigfile import BigFileMesh  # noqa: F401,E402
 from .algorithms.fftrecon import FFTRecon  # noqa: F401,E402
 from . import io  # noqa: F401,E402
+IO = io  # reference alias (lab.py:18 imports io as IO)
 from .algorithms.fof import FOF  # noqa: F401,E402
 from .source.catalog.halos import HaloCatalog  # noqa: F401,E402
 from .algorithms.pair_counters import (SimulationBoxPairCount,  # noqa: F401,E402
                                        SurveyDataPairCount)
+from .algorithms.pair_counters.base import PairCountBase  # noqa: F401,E402
 from .algorithms.paircount_tpcf import (SimulationBox2PCF,  # noqa: F401,E402
                                         SurveyData2PCF)
-from .algorithms.threeptcf import SimulationBox3PCF, SurveyData3PCF  # noqa: F401,E402
+from .algorithms.paircount_tpcf.estimators import WedgeBinnedStatistic  # noqa: F401,E402
+from .algorithms.threeptcf import (SimulationBox3PCF, SurveyData3PCF,  # noqa: F401,E402
+                                   YlmCache)
 from .algorithms.kdtree import KDDensity  # noqa: F401,E402
 from .algorithms.zhist import RedshiftHistogram  # noqa: F401,E402
 from .algorithms.cgm import CylindricalGroups  # noqa: F401,E402
@@ -44,7 +51,7 @@ from .algorithms.fibercollisions import FiberCollisions  # noqa: F401,E402
 from . import filters  # noqa: F401,E402
 from .filters import TopHat, Gaussian  # noqa: F401,E402
 from .hod import (HODModel, Zheng07Model, Leauthaud11Model,  # noqa: F401,E402
-                  Hearin15Model, HODModelFactory)
+                  Hearin15Model, HODModelFactory, PopulatedHaloCatalog)
 from .batch import TaskManager  # noqa: F401,E402
 from .source.catalog.subvolumes import SubVolumesCatalog  # noqa: F401,E402
 from .cosmology import FNLGalaxyPower, LinearNbody  # noqa: F401,E402

@@ -76,6 +76,18 @@ Gadget1Catalog = _make_file_catalog('Gadget1Catalog', _io.Gadget1File,
                                     'Gadget-1 snapshot')
 
 
+class FileCatalog(FileCatalogBase):
+    """Generic file catalog taking the FileType class as its first
+    argument (reference: nbodykit/source/catalog/file.py:202-231):
+    ``FileCatalog(filetype, path, ...)``."""
+
+    def __init__(self, filetype, path, *args, comm=None, attrs=None,
+                 **kwargs):
+        FileCatalogBase.__init__(self, filetype, args=(path,) + args,
+                                 kwargs=kwargs, comm=comm)
+        self.attrs.update(attrs or {})
+
+
 def FileCatalogFactory(name, filetype, examples=None):
     """Create a CatalogSource class reading a custom
     :class:`~nbodykit_tpu.io.base.FileType` subclass (reference
