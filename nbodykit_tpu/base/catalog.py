@@ -162,6 +162,51 @@ class CatalogSourceBase(object):
         self.attrs.update(getattr(other, 'attrs', {}))
         return self
 
+    @staticmethod
+    def make_column(array):
+        """Convert an array-like to a column array (reference
+        base/catalog.py:193 returns a dask array; columns here are
+        global device arrays)."""
+        return jnp.asarray(array)
+
+    @staticmethod
+    def create_instance(cls, comm=None):
+        """A bare, empty instance of ``cls`` with only the base state
+        initialized (reference base/catalog.py:223)."""
+        obj = object.__new__(cls)
+        CatalogSourceBase.__init__(obj, comm)
+        return obj
+
+    def copy(self):
+        """A shallow copy holding references to all current columns,
+        with a decoupled ``attrs`` (reference base/catalog.py:474)."""
+        toret = CatalogSourceBase.create_instance(self.__class__,
+                                                  comm=self.comm)
+        toret._size = len(self)
+        toret.__finalize__(self)
+        for col in self.columns:
+            toret[col] = self[col]
+        toret.attrs = dict(self.attrs)
+        return toret
+
+    def persist(self, columns=None):
+        """An ArrayCatalog with the selected columns materialized
+        (reference base/catalog.py:1078; columns here are already
+        device-resident, so this just snapshots them)."""
+        from ..source.catalog.array import ArrayCatalog
+        cols = {key: self[key] for key in (columns or self.columns)}
+        c = ArrayCatalog(cols, comm=self.comm)
+        c.attrs.update(self.attrs)
+        return c
+
+    def to_subvolumes(self, domain=None, position='Position',
+                      columns=None):
+        """Spatially domain-decomposed copy of this catalog (reference
+        base/catalog.py:754 -> SubVolumesCatalog)."""
+        from ..source.catalog.subvolumes import SubVolumesCatalog
+        return SubVolumesCatalog(self, domain=domain,
+                                 position=position, columns=columns)
+
     # -- conversion --------------------------------------------------------
 
     def to_mesh(self, Nmesh=None, BoxSize=None, dtype=None, interlaced=False,

@@ -177,6 +177,15 @@ class MeshSource(object):
         """The queue of deferred (mode, func, kind) transfer actions."""
         return self._actions
 
+    def view(self):
+        """A view MeshSource whose computation is owned by ``self``
+        (reference base/mesh.py:82)."""
+        import copy
+        view = copy.copy(self)
+        view.attrs = self.attrs.copy()
+        view.base = self
+        return view
+
     def apply(self, func, kind='wavenumber', mode='complex'):
         """Return a *view* of this mesh with ``func`` appended to the
         action queue (reference base/mesh.py:118-176). ``func`` takes

@@ -120,3 +120,90 @@ def attrs_to_dict(attrs, prefix=''):
     """Flatten an attrs dict with a prefix (reference analog used when
     saving meta-data to file headers)."""
     return {prefix + k: v for k, v in attrs.items()}
+
+
+def is_structured_array(arr):
+    """True if ``arr`` is a numpy structured array (reference
+    utils.py helper)."""
+    return getattr(getattr(arr, 'dtype', None), 'names', None) is not None
+
+
+def split_size_3d(s):
+    """Split ``s`` into (a, b, c) with a*b*c == s and a <= b <= c —
+    the 3-D process-grid factorization (reference utils.py:84-113),
+    used here to shape subvolume domain grids."""
+    a = int(s ** (1.0 / 3)) + 1
+    while a > 1 and s % a:
+        a -= 1
+    rest = s // a
+    b = int(rest ** 0.5) + 1
+    while b > 1 and rest % b:
+        b -= 1
+    c = rest // b
+    return tuple(sorted((a, b, c)))
+
+
+def get_data_bounds(data, comm=None, selection=None):
+    """Global (min, max) of an array along the first axis (reference
+    utils.py:23). Columns are global device arrays, so this is a plain
+    reduction (jit-fused; no chunking needed)."""
+    import jax.numpy as jnp
+    arr = jnp.asarray(data)
+    if selection is not None:
+        sel = jnp.asarray(selection, bool)
+        if jnp.issubdtype(arr.dtype, jnp.integer):
+            big = jnp.asarray(jnp.iinfo(arr.dtype).max, arr.dtype)
+            small = jnp.asarray(jnp.iinfo(arr.dtype).min, arr.dtype)
+        else:
+            big, small = (jnp.asarray(np.inf, arr.dtype),
+                          jnp.asarray(-np.inf, arr.dtype))
+        mask = sel[:, None] if arr.ndim > 1 else sel
+        lo = jnp.where(mask, arr, big)
+        hi = jnp.where(mask, arr, small)
+        return (np.asarray(lo.min(axis=0)), np.asarray(hi.max(axis=0)))
+    return (np.asarray(arr.min(axis=0)), np.asarray(arr.max(axis=0)))
+
+
+def GatherArray(data, comm=None, root=0):
+    """Materialize a (possibly device-sharded) array on the host
+    (reference utils.py:128 gathers rank-local pieces to root; columns
+    here are global device arrays, so the gather is a device-to-host
+    transfer — complex-safe via :func:`as_numpy`)."""
+    return as_numpy(data)
+
+
+def ScatterArray(data, comm=None, root=0, counts=None):
+    """Distribute a host array onto the active device mesh, sharded on
+    its leading axis (reference utils.py:249 scatters from root; here
+    the inverse of :func:`GatherArray`)."""
+    import jax.numpy as jnp
+    from .parallel.runtime import CurrentMesh, shard_leading
+    if counts is not None:
+        raise ValueError("explicit per-device counts are not "
+                         "supported: global arrays shard evenly")
+    arr = jnp.asarray(data)
+    mesh = CurrentMesh.get()
+    if mesh is not None and len(mesh.devices) > 1:
+        arr = shard_leading(mesh, arr)
+    return arr
+
+
+class captured_output(object):
+    """Context manager capturing Python-level stdout/stderr (reference
+    utils.py:513 captures C-level output via wurlitzer for its C
+    extensions; the compute here is in-process XLA, so Python streams
+    are the relevant ones). Yields (stdout, stderr) StringIO."""
+
+    def __enter__(self):
+        import io as _io
+        import sys
+        self._sys = sys
+        self._old = (sys.stdout, sys.stderr)
+        self.stdout = _io.StringIO()
+        self.stderr = _io.StringIO()
+        sys.stdout, sys.stderr = self.stdout, self.stderr
+        return self.stdout, self.stderr
+
+    def __exit__(self, *exc):
+        self._sys.stdout, self._sys.stderr = self._old
+        return False

@@ -300,3 +300,67 @@ def test_file_catalog_generic(tmp_path):
                       attrs={'tag': 1})
     assert cat.size == 4 and cat.attrs['tag'] == 1
     np.testing.assert_allclose(np.asarray(cat['b']), arr[:, 1])
+
+
+def test_catalog_parity_methods(comm):
+    """copy/persist/to_subvolumes/make_column/create_instance and
+    MeshSource.view (reference base/catalog.py:193,223,474,754,1078;
+    base/mesh.py:82)."""
+    from nbodykit_tpu.source.catalog.uniform import UniformCatalog
+    from nbodykit_tpu.base.catalog import CatalogSourceBase
+    from nbodykit_tpu.parallel.runtime import use_mesh
+
+    with use_mesh(comm):
+        c = UniformCatalog(nbar=1e-3, BoxSize=100.0, seed=3)
+    c2 = c.copy()
+    assert c2.size == c.size
+    c2.attrs['x'] = 1
+    assert 'x' not in c.attrs  # attrs decoupled, unlike view
+
+    p = c.persist(['Position'])
+    np.testing.assert_allclose(np.asarray(p['Position']),
+                               np.asarray(c['Position']))
+
+    sv = c.to_subvolumes(domain=[2, 2, 2])
+    assert sv.size == c.size and 'SubVolumeIndex' in sv.columns
+    # subvolume ids are sorted, so the catalog is spatially grouped
+    ids = np.asarray(sv['SubVolumeIndex'])
+    assert (np.diff(ids) >= 0).all()
+
+    assert c.make_column(np.arange(4)).shape == (4,)
+    inst = CatalogSourceBase.create_instance(UniformCatalog)
+    assert isinstance(inst, UniformCatalog)
+    assert inst.attrs == {} and inst._columns == {}
+
+    m = c.to_mesh(Nmesh=16)
+    v = m.view()
+    assert v.base is m and v.attrs == m.attrs
+
+
+def test_utils_parity_functions(comm):
+    """split_size_3d/get_data_bounds/Gather-ScatterArray/
+    is_structured_array/captured_output (reference utils.py)."""
+    import nbodykit_tpu.utils as U
+
+    assert U.split_size_3d(12) == (2, 2, 3)
+    assert U.split_size_3d(8) == (2, 2, 2)
+    assert U.split_size_3d(7) == (1, 1, 7)
+
+    lo, hi = U.get_data_bounds(np.arange(12.).reshape(4, 3))
+    np.testing.assert_allclose(lo, [0, 1, 2])
+    np.testing.assert_allclose(hi, [9, 10, 11])
+    lo, hi = U.get_data_bounds(np.arange(12.).reshape(4, 3),
+                               selection=np.array([1, 1, 0, 0], bool))
+    np.testing.assert_allclose(hi, [3, 4, 5])
+
+    from nbodykit_tpu.parallel.runtime import use_mesh
+    with use_mesh(comm):
+        host = U.GatherArray(np.ones(16))
+        dev = U.ScatterArray(host)
+    assert dev.shape == (16,)
+
+    assert U.is_structured_array(np.zeros(3, dtype=[('a', 'f8')]))
+    assert not U.is_structured_array(np.zeros(3))
+    with U.captured_output() as (out, err):
+        print('hi')
+    assert out.getvalue() == 'hi\n'
