@@ -149,22 +149,24 @@ def readout_local(block, pos, resampler='cic', period=None, origin=0,
 
 def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
                       origin=0, out=None, npasses=None):
-    """Scatter-free paint: sort + segmented log-shift reduction + gather.
+    """Collision-free paint: sort + segmented reduction + unique scatter.
 
-    TPU scatter-add serializes on colliding indices; this variant never
-    scatters. All (cell, weight) deposit terms are concatenated with one
-    zero-weight sentinel per cell, sorted by cell, segment-summed with
-    doubling shift-add passes (exact — no global cumsum, so f32
-    precision is preserved), and the per-cell totals are *gathered* at
-    each cell's last occurrence (present by construction thanks to the
-    sentinels).
+    TPU scatter-add serializes on colliding indices. Here all (cell,
+    weight) deposit terms are sorted by cell, each equal-cell run is
+    summed with doubling shift-add passes (exact — no global cumsum, so
+    f32 precision is preserved), the per-run totals are compacted to one
+    entry per distinct cell, and a single scatter with *provably unique*
+    indices deposits them (``unique_indices=True`` — XLA needs no
+    serialization). Unused compaction slots get distinct out-of-bounds
+    indices and are dropped, keeping the uniqueness claim honest.
 
-    The shift loop runs as a lax.while_loop until no segment spans the
+    The shift loop runs as a lax.while_loop until no run spans the
     current shift, so arbitrarily long collision runs are summed exactly
     (cost: log2(max occupancy) passes).
 
-    Memory is O(n * s^3 + M); prefer :func:`paint_local` (chunked
-    scatter) when that does not fit.
+    Memory is O(n * s^3) beyond the output block — unlike the round-1
+    sentinel design there is no O(M) term, so this scales to
+    Nmesh=1024 (M=1e9) meshes.
 
     npasses : optional static cap on the doubling passes (mostly for
         testing); None iterates to completion.
@@ -179,8 +181,7 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
         mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
     mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
 
-    lins = [jnp.arange(M, dtype=jnp.int32)]
-    ws = [jnp.zeros(M, dtype=dtype)]
+    lins, ws = [], []
     for lin, w in _offset_terms(pos, mass, resampler, period, origin,
                                 n0l):
         lins.append(lin.astype(jnp.int32))
@@ -214,9 +215,15 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     vals, _, _ = jax.lax.while_loop(
         cond, body, (vals, jnp.int32(1), jnp.asarray(True)))
 
-    ends = jnp.searchsorted(keys, jnp.arange(M, dtype=jnp.int32),
-                            side='right') - 1
-    block = vals[ends].astype(dtype).reshape(shape)
-    if out is not None:
-        block = out + block
-    return block
+    # one scatter with provably unique indices: run-end entries carry
+    # their run's total to its (distinct) cell; every other entry gets
+    # a distinct out-of-bounds index and is dropped
+    is_last = jnp.concatenate(
+        [keys[1:] != keys[:-1], jnp.ones((1,), bool)])
+    skeys = jnp.where(is_last, keys, M + idx)
+    svals = jnp.where(is_last, vals, 0)
+
+    flat = jnp.zeros(M, dtype=dtype) if out is None else \
+        out.reshape(-1)
+    flat = flat.at[skeys].add(svals, mode='drop', unique_indices=True)
+    return flat.reshape(shape)
