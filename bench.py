@@ -446,14 +446,27 @@ def cmd_worker():
     detail['state'] = 'running'
     _flush_detail(detail)
 
-    # paint microbench at a mid scale (cheap, kernel-level tracking)
-    try:
-        p = run_paint(256, 1_000_000)
-        detail['paint'].append(p)
-        note("paint micro: %s" % p)
-    except Exception as e:
-        detail['paint'].append({"error": str(e)[:300]})
-        note("paint micro failed: %s" % e)
+    # paint microbench at a mid scale, both kernels; the winner paints
+    # the ladder (scatter-add vs sort+unique-scatter is a hardware
+    # question — TPU scatter serializes on collisions, sort costs
+    # O(n log^2 n) bitonic passes)
+    results = {}
+    for method in ('scatter', 'sort'):
+        try:
+            p = run_paint(256, 1_000_000, method=method)
+            detail['paint'].append(p)
+            note("paint micro: %s" % p)
+            results[method] = p['value']  # wallclock, unrounded enough
+        except Exception as e:
+            detail['paint'].append({"method": method,
+                                    "error": str(e)[:300]})
+            note("paint micro (%s) failed: %s" % (method, e))
+    # winner = fastest SUCCEEDED method (a failed kernel must never
+    # paint the ladder); default scatter only when both failed
+    best_method = min(results, key=results.get) if results \
+        else 'scatter'
+    detail['paint_method'] = best_method
+    note("ladder paint method: %s" % best_method)
     _flush_detail(detail)
 
     # smallest-first ladder up to the north-star config; every step is
@@ -480,7 +493,7 @@ def cmd_worker():
         detail['state'] = 'config_nmesh%d_npart%.0e' % (Nmesh, Npart)
         _flush_detail(detail)
         try:
-            res = run_config(Nmesh, Npart)
+            res = run_config(Nmesh, Npart, method=best_method)
             detail['configs'].append(res)
             _cache_tpu_result(res)
             note("ok: %s" % res)

@@ -1,0 +1,46 @@
+"""Shared compile-on-demand loader for the C++ kernels in ``csrc/``.
+
+Used by ``cosmology/_native.py`` (Boltzmann BDF2 kernel) and
+``io/_native.py`` (bigfile block reader). Compiles with g++, caches
+the .so by source hash under ``~/.cache/nbodykit_tpu`` (override with
+``NBKIT_TPU_NATIVE_CACHE``; disable all native kernels with
+``NBKIT_TPU_NO_NATIVE``). Failures are recorded, not raised — every
+caller has a pure-Python fallback.
+
+Plain C ABI + ctypes: pybind11 is not available in this environment.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     '..', 'csrc')
+_CACHE = os.environ.get(
+    'NBKIT_TPU_NATIVE_CACHE',
+    os.path.join(os.path.expanduser('~'), '.cache', 'nbodykit_tpu'))
+
+
+def build_kernel(src_name, extra_flags=()):
+    """Compile ``csrc/<src_name>`` (cached) and return
+    ``(ctypes.CDLL or None, error string or None)``."""
+    if os.environ.get('NBKIT_TPU_NO_NATIVE'):
+        return None, 'disabled by NBKIT_TPU_NO_NATIVE'
+    try:
+        src_path = os.path.abspath(os.path.join(_CSRC, src_name))
+        with open(src_path, 'rb') as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        os.makedirs(_CACHE, exist_ok=True)
+        stem = os.path.splitext(src_name)[0]
+        so = os.path.join(_CACHE, '%s_%s.so' % (stem, tag))
+        if not os.path.exists(so):
+            tmp = so + '.tmp.%d' % os.getpid()
+            subprocess.run(
+                ['g++', '-O3', '-shared', '-fPIC', '-std=c++17']
+                + list(extra_flags) + ['-o', tmp, src_path],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        return ctypes.CDLL(so), None
+    except Exception as e:          # noqa: BLE001 - fallback by design
+        return None, str(e)

@@ -13,17 +13,10 @@ components with ctypes/cffi bindings).
 """
 
 import ctypes
-import hashlib
-import os
-import subprocess
 
 import numpy as np
 
-_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                    '..', '..', 'csrc', 'boltzmann_kernel.cpp')
-_CACHE = os.environ.get(
-    'NBKIT_TPU_NATIVE_CACHE',
-    os.path.join(os.path.expanduser('~'), '.cache', 'nbodykit_tpu'))
+from .._native_build import build_kernel
 
 _lib = None
 _lib_err = None
@@ -37,28 +30,9 @@ def _build():
     global _lib, _lib_err
     if _lib is not None or _lib_err is not None:
         return _lib
-    if os.environ.get('NBKIT_TPU_NO_NATIVE'):
-        _lib_err = 'disabled by NBKIT_TPU_NO_NATIVE'
-        return None
-    try:
-        src_path = os.path.abspath(_SRC)
-        with open(src_path, 'rb') as f:
-            tag = hashlib.sha256(f.read()).hexdigest()[:16]
-        os.makedirs(_CACHE, exist_ok=True)
-        so = os.path.join(_CACHE, 'boltzmann_kernel_%s.so' % tag)
-        if not os.path.exists(so):
-            tmp = so + '.tmp.%d' % os.getpid()
-            subprocess.run(
-                ['g++', '-O3', '-shared', '-fPIC', '-std=c++17',
-                 '-o', tmp, src_path],
-                check=True, capture_output=True, timeout=120)
-            os.replace(tmp, so)
-        lib = ctypes.CDLL(so)
-        lib.nbk_solve_mode.restype = ctypes.c_int
-        _lib = lib
-    except Exception as e:          # noqa: BLE001 - fallback by design
-        _lib_err = str(e)
-        _lib = None
+    _lib, _lib_err = build_kernel('boltzmann_kernel.cpp')
+    if _lib is not None:
+        _lib.nbk_solve_mode.restype = ctypes.c_int
     return _lib
 
 

@@ -1,0 +1,78 @@
+"""ctypes loader for the native bigfile IO kernel.
+
+Compiles ``csrc/bigfile_io.cpp`` on demand with g++ (cached by source
+hash under ``~/.cache/nbodykit_tpu``) and exposes :func:`read_block`
+(threaded part-file reads) and :func:`checksum` for
+``nbodykit_tpu/io/bigfile.py``. Any failure falls back to the pure
+numpy path — the kernel is an accelerator, not a dependency.
+
+Same binding pattern as ``cosmology/_native.py`` (plain C ABI +
+ctypes; pybind11 is not available in this environment).
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+from .._native_build import build_kernel
+
+_lib = None
+_lib_err = None
+
+
+def _build():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    _lib, _lib_err = build_kernel('bigfile_io.cpp',
+                                  extra_flags=('-pthread',))
+    if _lib is not None:
+        _lib.nbk_bigfile_read.restype = ctypes.c_int
+        _lib.nbk_checksum.restype = ctypes.c_uint
+    return _lib
+
+
+def native_available():
+    return _build() is not None
+
+
+def checksum(data):
+    """32-bit byte-sum of an array's payload, or None if the kernel is
+    unavailable."""
+    lib = _build()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+    return int(lib.nbk_checksum(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.c_long(buf.size)))
+
+
+def read_block(bdir, bounds, dtype, nmemb, start, stop, nthreads=None):
+    """Read records [start, stop) of the block at ``bdir`` into a new
+    array, with one reader thread per part-file segment. Returns None
+    if the kernel is unavailable or reports a failure (caller falls
+    back to the numpy loop)."""
+    lib = _build()
+    if lib is None:
+        return None
+    nfile = len(bounds) - 1
+    itemsize = np.dtype(dtype).itemsize * nmemb
+    n = stop - start
+    out = np.empty(n * nmemb, dtype=dtype)
+    if n <= 0:
+        return out.reshape((0, nmemb) if nmemb > 1 else (0,))
+    bounds_c = np.ascontiguousarray(bounds, dtype=np.int64)
+    if nthreads is None:
+        nthreads = min(max(os.cpu_count() or 1, 1), 16)
+    rc = lib.nbk_bigfile_read(
+        bdir.encode(), ctypes.c_int(nfile),
+        bounds_c.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        ctypes.c_long(itemsize), ctypes.c_long(start),
+        ctypes.c_long(stop),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.c_int(nthreads))
+    if rc != 0:
+        return None
+    return out.reshape((n, nmemb) if nmemb > 1 else (n,))

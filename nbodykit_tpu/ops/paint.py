@@ -224,6 +224,6 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     svals = jnp.where(is_last, vals, 0)
 
     flat = jnp.zeros(M, dtype=dtype) if out is None else \
-        out.reshape(-1)
+        jnp.asarray(out).reshape(-1)
     flat = flat.at[skeys].add(svals, mode='drop', unique_indices=True)
     return flat.reshape(shape)
