@@ -59,10 +59,12 @@ def read_block(bdir, bounds, dtype, nmemb, start, stop, nthreads=None):
         return None
     nfile = len(bounds) - 1
     itemsize = np.dtype(dtype).itemsize * nmemb
+    if not (0 <= start <= stop <= bounds[-1]):
+        return None  # caller's numpy path raises its own range error
     n = stop - start
-    out = np.empty(n * nmemb, dtype=dtype)
     if n <= 0:
-        return out.reshape((0, nmemb) if nmemb > 1 else (0,))
+        return np.empty((0, nmemb) if nmemb > 1 else (0,), dtype=dtype)
+    out = np.empty(n * nmemb, dtype=dtype)
     bounds_c = np.ascontiguousarray(bounds, dtype=np.int64)
     if nthreads is None:
         nthreads = min(max(os.cpu_count() or 1, 1), 16)

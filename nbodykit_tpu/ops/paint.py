@@ -83,7 +83,7 @@ def paint_local(pos, mass, shape, resampler='cic', period=None, origin=0,
     dtype = out.dtype if out is not None else (
         mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
     flat = jnp.zeros(n0l * N1 * N2, dtype=dtype) if out is None \
-        else out.reshape(-1)
+        else jnp.asarray(out).reshape(-1)
 
     mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
 

@@ -1,0 +1,16 @@
+"""Matplotlib style presets (reference: nbodykit/style — rc parameter
+sets loadable with ``matplotlib.pyplot.style.use(style.notebook)``)."""
+
+__all__ = ['notebook']
+
+import os
+
+_cwd = os.path.dirname(os.path.abspath(__file__))
+
+try:
+    import matplotlib
+    notebook = matplotlib.rc_params_from_file(
+        os.path.join(_cwd, 'notebook.mplstyle'),
+        use_default_template=False)
+except Exception:          # matplotlib not installed: expose the path
+    notebook = os.path.join(_cwd, 'notebook.mplstyle')

@@ -364,3 +364,12 @@ def test_utils_parity_functions(comm):
     with U.captured_output() as (out, err):
         print('hi')
     assert out.getvalue() == 'hi\n'
+
+
+def test_style_module():
+    """style.notebook loads as matplotlib rc params (reference:
+    nbodykit/style)."""
+    from nbodykit_tpu import style
+    assert 'notebook' in style.__all__
+    nb = style.notebook
+    assert isinstance(nb, (dict, str)) or hasattr(nb, 'keys')
