@@ -253,6 +253,10 @@ class BigFileDataset(object):
         return self.shape[0]
 
     def read(self, start, stop):
+        if not (0 <= start <= stop <= self.size):
+            raise IndexError(
+                "record range [%d, %d) outside block of size %d"
+                % (start, stop, self.size))
         itemshape = self.shape[1:]
         nper = self.nmemb
         from . import _native

@@ -5,6 +5,7 @@ save/load.
 """
 
 import numpy as np
+import jax.numpy as jnp
 import pytest
 
 from nbodykit_tpu.lab import (UniformCatalog, LogNormalCatalog,
@@ -146,3 +147,50 @@ def test_convpower_odd_poles_c2c(fkp_setup):
     # dipole of a (nearly) periodic box sample is tiny compared to P0
     p1 = r_odd.poles['power_1'].real
     assert np.nanmax(np.abs(p1[sel])) < 0.1 * np.nanmax(np.abs(p0e[sel]))
+
+
+def test_convpower_no_monopole(comm):
+    """poles without ell=0 still run (reference test_no_monopole)."""
+    from nbodykit_tpu.parallel.runtime import use_mesh
+    with use_mesh(comm):
+        d = UniformCatalog(nbar=3e-3, BoxSize=100.0, seed=12)
+        r = UniformCatalog(nbar=3e-2, BoxSize=100.0, seed=13)
+        d['NZ'] = 3e-3 * jnp.ones(d.size)
+        r['NZ'] = 3e-3 * jnp.ones(r.size)
+        mesh = FKPCatalog(d, r).to_mesh(Nmesh=32, resampler='tsc')
+        p = ConvolvedFFTPower(mesh, poles=[2], dk=0.1, kmin=0.01)
+    assert 'power_2' in p.poles.variables
+    assert np.isfinite(np.asarray(p.poles['power_2'].real)).any()
+
+
+def test_convpower_cross_equals_auto(comm):
+    """second=same mesh reproduces the auto spectrum exactly
+    (reference test_cross_corr)."""
+    from nbodykit_tpu.parallel.runtime import use_mesh
+    with use_mesh(comm):
+        d = UniformCatalog(nbar=3e-3, BoxSize=100.0, seed=12)
+        r = UniformCatalog(nbar=3e-2, BoxSize=100.0, seed=13)
+        d['NZ'] = 3e-3 * jnp.ones(d.size)
+        r['NZ'] = 3e-3 * jnp.ones(r.size)
+        mesh = FKPCatalog(d, r).to_mesh(Nmesh=32, resampler='tsc')
+        auto = ConvolvedFFTPower(mesh, poles=[0, 2], dk=0.1, kmin=0.01)
+        cross = ConvolvedFFTPower(mesh, poles=[0, 2], second=mesh,
+                                  dk=0.1, kmin=0.01)
+    np.testing.assert_allclose(
+        np.asarray(auto.poles['power_0'].real),
+        np.asarray(cross.poles['power_0'].real), rtol=1e-10)
+
+
+def test_convpower_window_only(comm):
+    """Zero data weight measures the window function without error
+    (reference test_window_only)."""
+    from nbodykit_tpu.parallel.runtime import use_mesh
+    with use_mesh(comm):
+        d = UniformCatalog(nbar=3e-3, BoxSize=100.0, seed=14)
+        r = UniformCatalog(nbar=3e-2, BoxSize=100.0, seed=13)
+        d['NZ'] = 3e-3 * jnp.ones(d.size)
+        r['NZ'] = 3e-3 * jnp.ones(r.size)
+        d['Weight'] = jnp.zeros(d.size)
+        mesh = FKPCatalog(d, r).to_mesh(Nmesh=32)
+        p = ConvolvedFFTPower(mesh, poles=[0], dk=0.1)
+    assert np.isfinite(np.asarray(p.poles['power_0'].real)).any()

@@ -287,3 +287,20 @@ def test_bigfile_native_reader_parity(tmp_path):
         np.testing.assert_array_equal(native.reshape(-1), want)
     # and the public read() path (which prefers the native kernel)
     np.testing.assert_array_equal(ds.read(10, 990), data[10:990])
+
+
+def test_bigfile_read_range_validated(tmp_path):
+    """Out-of-range record requests raise instead of returning
+    uninitialized memory."""
+    from nbodykit_tpu.io.bigfile import BigFileWriter, BigFileDataset
+
+    path = str(tmp_path / 'blk')
+    with BigFileWriter(path) as bf:
+        bf.write('X', np.arange(10.0), nfile=2)
+    ds = BigFileDataset(path, 'X')
+    with pytest.raises(IndexError):
+        ds.read(0, 11)
+    with pytest.raises(IndexError):
+        ds.read(-1, 5)
+    with pytest.raises(IndexError):
+        ds.read(7, 3)
