@@ -250,6 +250,13 @@ def _time_fn(jax, fn, args, reps):
 def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     """One full config measurement; returns a result dict."""
     jax = _setup_jax()
+    overridden = False
+    if Npart >= 50_000_000 and method == 'sort' \
+            and jax.devices()[0].platform in TPU_PLATFORMS:
+        # sort paint materializes ~16 bytes * 8 * Npart of sort
+        # temporaries (~13 GB at 1e8) — over v5e HBM next to the
+        # field; the chunked scatter paint bounds its live set
+        method, overridden = 'scatter', True
     import jax.numpy as jnp
     import nbodykit_tpu
     from nbodykit_tpu.pmesh import ParticleMesh
@@ -264,6 +271,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         "unit": "s", "paint_method": method,
         "platform": jax.devices()[0].platform,
         "nmesh": Nmesh, "npart": Npart,
+        **({"paint_method_overridden": "sort->scatter (HBM)"}
+           if overridden else {}),
     }
     # the axon remote-compile helper dies on the fused program at
     # Nmesh>=512 (HTTP 500 / subprocess exit 1, and the dead helper

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.gridhash import GridHash
+from ...utils import working_dtype
 from ...ops.devicehash import DeviceGridHash
 
 
@@ -166,6 +167,7 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
     pos2 = np.asarray(pos2, dtype='f8')
     w1 = np.ones(len(pos1)) if w1 is None else np.asarray(w1, 'f8')
     w2 = np.ones(len(pos2)) if w2 is None else np.asarray(w2, 'f8')
+    wdt = working_dtype('f8')  # f4 when x64 is off (TPU) — silent
 
     p1, p2, work_box, redges, rmax, nb1, nb2, periodic = _mode_setup(
         pos1, pos2, box, edges, mode, Nmu, pimax, grid_origin, periodic)
@@ -184,8 +186,8 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
         body = _fold_body(grid, w2_s, r2edges, mode, nb1, nb2, pimax,
                           losj, origin_j, pair_los, is_auto,
                           p1c, w1c, live1)
-        init = (jnp.zeros(nbins_flat, jnp.float64),
-                jnp.zeros(nbins_flat, jnp.float64))
+        init = (jnp.zeros(nbins_flat, wdt),
+                jnp.zeros(nbins_flat, wdt))
         return grid.fold(p1c, ci1, body, init)
 
     N1 = len(p1)
@@ -219,14 +221,15 @@ def paircount_dist(pos1, w1, pos2, w2, box, edges, mesh, mode='1d',
     from ...parallel.domain import slab_route
     from ...parallel.runtime import AXIS, shard_leading
 
-    pos1 = jnp.asarray(pos1, jnp.float64)
-    pos2 = jnp.asarray(pos2, jnp.float64)
+    wdt = working_dtype('f8')  # f4 when x64 is off (TPU) — silent
+    pos1 = jnp.asarray(pos1, wdt)
+    pos2 = jnp.asarray(pos2, wdt)
     n1 = pos1.shape[0]
     n2 = pos2.shape[0]
-    w1 = jnp.ones(n1, jnp.float64) if w1 is None \
-        else jnp.asarray(w1, jnp.float64)
-    w2 = jnp.ones(n2, jnp.float64) if w2 is None \
-        else jnp.asarray(w2, jnp.float64)
+    w1 = jnp.ones(n1, wdt) if w1 is None \
+        else jnp.asarray(w1, wdt)
+    w2 = jnp.ones(n2, wdt) if w2 is None \
+        else jnp.asarray(w2, wdt)
 
     p1, p2, work_box, redges, rmax, nb1, nb2, periodic = _mode_setup(
         pos1, pos2, box, edges, mode, Nmu, pimax, grid_origin, periodic)
@@ -261,8 +264,8 @@ def paircount_dist(pos1, w1, pos2, w2, box, edges, mesh, mode='1d',
         body = _fold_body(grid, w2_s, r2edges, mode, nb1, nb2, pimax,
                           losj, origin_j, pair_los, is_auto,
                           p1_l, w1_l, ok1_l)
-        init = (jnp.zeros(nbins_flat, jnp.float64),
-                jnp.zeros(nbins_flat, jnp.float64))
+        init = (jnp.zeros(nbins_flat, wdt),
+                jnp.zeros(nbins_flat, wdt))
         npairs, wpairs = grid.fold(p1_l, ci1, body, init)
         return (jax.lax.psum(npairs, AXIS),
                 jax.lax.psum(wpairs, AXIS))

@@ -61,7 +61,11 @@ class ParticleMesh(object):
     def __init__(self, Nmesh, BoxSize, dtype='f4', comm=None):
         self.Nmesh = _triplet(Nmesh, 'i8')
         self.BoxSize = _triplet(BoxSize, 'f8')
-        self.dtype = np.dtype(dtype)
+        from .utils import working_dtype
+        # canonicalize up front: an f8 mesh with x64 disabled (the TPU
+        # reality) IS an f4 mesh — deciding here keeps every kernel
+        # below free of per-callsite truncation warnings
+        self.dtype = working_dtype(dtype)
         self.comm = CurrentMesh.resolve(comm)
         self.nproc = mesh_size(self.comm)
         if int(self.Nmesh[0]) % self.nproc or int(self.Nmesh[1]) % self.nproc:
@@ -133,7 +137,8 @@ class ParticleMesh(object):
     def x_list(self, dtype=None):
         """Broadcastable real-space coordinate arrays [x, y, z] for the
         (N0, N1, N2) real layout: x_i = index * cellsize_i, in [0, L)."""
-        dtype = dtype or self.dtype
+        from .utils import working_dtype
+        dtype = working_dtype(dtype or self.dtype)
         out = []
         for ax, (n, h) in enumerate(zip(self.Nmesh, self.cellsize)):
             shape = [1, 1, 1]
@@ -151,8 +156,10 @@ class ParticleMesh(object):
         nbodykit/base/mesh.py:132-145). ``full=True`` gives the
         uncompressed kz axis (c2c layout) instead of the rfft half.
         """
-        dtype = dtype or (jnp.float32 if self.dtype.itemsize <= 4
-                          else jnp.float64)
+        from .utils import working_dtype
+        dtype = working_dtype(dtype) if dtype is not None else (
+            jnp.float32 if self.dtype.itemsize <= 4
+            else working_dtype('f8'))
         N0, N1, N2 = (int(n) for n in self.Nmesh)
         L = self.BoxSize
 

@@ -50,14 +50,16 @@ class DistributedRNG(object):
         return arr
 
     def uniform(self, low=0.0, high=1.0, itemshape=None, dtype='f8'):
+        from .utils import working_dtype
         u = jax.random.uniform(self._next_key(), self._shape(itemshape),
-                               dtype=jnp.dtype(dtype), minval=low,
+                               dtype=working_dtype(dtype), minval=low,
                                maxval=high)
         return self._place(u)
 
     def normal(self, loc=0.0, scale=1.0, itemshape=None, dtype='f8'):
+        from .utils import working_dtype
         g = jax.random.normal(self._next_key(), self._shape(itemshape),
-                              dtype=jnp.dtype(dtype))
+                              dtype=working_dtype(dtype))
         return self._place(g * scale + loc)
 
     def poisson(self, lam, itemshape=None, dtype='i8'):
@@ -66,7 +68,8 @@ class DistributedRNG(object):
         if lam.ndim > 0:
             shape = jnp.broadcast_shapes(shape, lam.shape)
         p = jax.random.poisson(self._next_key(), lam, shape=shape)
-        return self._place(p.astype(jnp.dtype(dtype)))
+        dt = jnp.zeros(0, jnp.dtype(dtype)).dtype  # canonical (x64-off
+        return self._place(p.astype(dt))           # -> i4, silent)
 
     def choice(self, choices, p=None, itemshape=None):
         choices = jnp.asarray(choices)

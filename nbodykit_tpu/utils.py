@@ -12,6 +12,19 @@ import numpy as np
 import jax
 
 
+def working_dtype(dt='f8'):
+    """The widest available real dtype no wider than ``dt``: float64
+    when x64 is enabled, else float32 — *without* the per-callsite
+    "requested dtype float64 ... truncated" warning that a direct
+    ``jnp.asarray(x, jnp.float64)`` emits on TPU (no f64 hardware).
+    Use for 'compute in the best precision we have' sites."""
+    import jax
+    if np.dtype(dt).kind == 'f' and np.dtype(dt).itemsize == 8 \
+            and not jax.config.jax_enable_x64:
+        return np.dtype('f4')
+    return np.dtype(dt)
+
+
 def as_numpy(arr):
     """Fetch a jax array to host numpy.
 

@@ -172,9 +172,14 @@ def test_convpower_cross_equals_auto(comm):
         r = UniformCatalog(nbar=3e-2, BoxSize=100.0, seed=13)
         d['NZ'] = 3e-3 * jnp.ones(d.size)
         r['NZ'] = 3e-3 * jnp.ones(r.size)
-        mesh = FKPCatalog(d, r).to_mesh(Nmesh=32, resampler='tsc')
+        fkp = FKPCatalog(d, r)
+        mesh = fkp.to_mesh(Nmesh=32, resampler='tsc')
+        # a DISTINCT second mesh of the same catalog: the cross branch
+        # (separate second paint + A0*Aell' product) actually executes
+        mesh2 = fkp.to_mesh(Nmesh=32, resampler='tsc')
+        assert mesh2 is not mesh
         auto = ConvolvedFFTPower(mesh, poles=[0, 2], dk=0.1, kmin=0.01)
-        cross = ConvolvedFFTPower(mesh, poles=[0, 2], second=mesh,
+        cross = ConvolvedFFTPower(mesh, poles=[0, 2], second=mesh2,
                                   dk=0.1, kmin=0.01)
     np.testing.assert_allclose(
         np.asarray(auto.poles['power_0'].real),
