@@ -136,3 +136,46 @@ def test_fftpower_f32_matches_f64_within_1e4(tmp_path):
     # around zero; measured f32 error is ~2e-6 abs vs a 0.046 range)
     xscale = max(np.abs(xi64[okc]).max(), 1e-30)
     assert (np.abs(xi32[okc] - xi64[okc]) / xscale).max() < 1e-4
+
+
+_WARN_CHILD = r"""
+import sys, warnings
+sys.path.insert(0, %(root)r)
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', False)
+import numpy as np
+with warnings.catch_warnings(record=True) as caught:
+    warnings.simplefilter('always')
+    from nbodykit_tpu.lab import UniformCatalog, FFTPower
+    from nbodykit_tpu.algorithms.pair_counters.simbox import \
+        SimulationBoxPairCount
+    cat = UniformCatalog(nbar=2e-3, BoxSize=64.0, seed=5)
+    r = FFTPower(cat, mode='1d', Nmesh=32)
+    pc = SimulationBoxPairCount('1d', cat, np.linspace(1.0, 8.0, 5))
+trunc = [w for w in caught
+         if 'truncated to dtype float32' in str(w.message)
+         and 'nbodykit_tpu' in (w.filename or '')]
+for w in trunc:
+    print('TRUNCWARN %%s:%%d' %% (w.filename, w.lineno))
+print('NWARN', len(trunc))
+"""
+
+
+@pytest.mark.slow
+def test_no_truncation_warnings_x64_off(tmp_path):
+    """The x64-off (TPU-regime) pipeline emits no f64-truncation
+    warnings from package code — f8 requests are canonicalized up
+    front (utils.working_dtype)."""
+    script = tmp_path / 'child_warn.py'
+    script.write_text(_WARN_CHILD % {'root': os.path.dirname(HERE)})
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('XLA_FLAGS', None)
+    proc = subprocess.run(
+        [sys.executable, str(script)], env=env, cwd=HERE,
+        capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.strip().splitlines()
+    assert lines[-1].startswith('NWARN'), proc.stdout[-500:]
+    nwarn = int(lines[-1].split()[1])
+    assert nwarn == 0, '\n'.join(lines)
