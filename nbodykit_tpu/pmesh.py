@@ -191,11 +191,12 @@ class ParticleMesh(object):
         """Double-count weights for the compressed kz half-space: weight 2
         for 0 < kz < Nyquist, weight 1 on the kz=0 and Nyquist planes
         (reference: nbodykit/meshtools.py:188-215)."""
+        from .utils import working_dtype
         N2 = int(self.Nmesh[2])
         nz = N2 // 2 + 1
         iz = jnp.arange(nz)
         w = jnp.where((iz > 0) & ~((N2 % 2 == 0) & (iz == N2 // 2)), 2.0, 1.0)
-        return w.astype(dtype).reshape(1, 1, nz)
+        return w.astype(working_dtype(dtype)).reshape(1, 1, nz)
 
     # -- paint / readout --------------------------------------------------
 

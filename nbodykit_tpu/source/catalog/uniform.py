@@ -48,11 +48,13 @@ class UniformCatalog(RandomCatalog):
         self.attrs['BoxSize'] = _BoxSize
         self.attrs['nbar'] = nbar
 
+        from ...utils import working_dtype
+        wdt = working_dtype(dtype)
         box = np.asarray(_BoxSize)
-        self._pos = (self.rng.uniform(itemshape=(3,), dtype=dtype) * box
-                     ).astype(dtype)
-        self._vel = (self.rng.uniform(itemshape=(3,), dtype=dtype) * box
-                     * 0.01).astype(dtype)
+        self._pos = (self.rng.uniform(itemshape=(3,), dtype=wdt) * box
+                     ).astype(wdt)
+        self._vel = (self.rng.uniform(itemshape=(3,), dtype=wdt) * box
+                     * 0.01).astype(wdt)
 
     def __repr__(self):
         return "UniformCatalog(size=%d, seed=%s)" % (
