@@ -34,10 +34,13 @@ def pytest_addoption(parser):
 
 
 def pytest_configure(config):
-    # CPU default so collection cannot block on a wedged TPU tunnel;
-    # TPU runs set JAX_PLATFORMS explicitly
+    # CPU unless the run EXPLICITLY opts into the TPU with
+    # BENCH_PLATFORM=tpu. The ambient environment exports
+    # JAX_PLATFORMS=axon (the sitecustomize does, not the user), so
+    # keying on JAX_PLATFORMS would block collection on a wedged
+    # tunnel — the round-4 failure mode this guard exists for.
     import jax
-    if 'cpu' in os.environ.get('JAX_PLATFORMS', 'cpu'):
+    if os.environ.get('BENCH_PLATFORM', 'cpu') != 'tpu':
         jax.config.update('jax_platforms', 'cpu')
 
 
