@@ -212,8 +212,14 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
         active = jnp.any((idx >= 2 * shift) & (keys == keys[src2]))
         return vals, shift * 2, active
 
+    # initial 'active' must be derived from the (device-varying) data:
+    # a literal True has an unvarying vma type under shard_map and the
+    # while_loop carry then type-mismatches the body's data-derived
+    # output (always True in value — every nonempty sort may need a
+    # pass)
+    active0 = jnp.any(keys == keys)
     vals, _, _ = jax.lax.while_loop(
-        cond, body, (vals, jnp.int32(1), jnp.asarray(True)))
+        cond, body, (vals, jnp.int32(1), active0))
 
     # one scatter with provably unique indices: run-end entries carry
     # their run's total to its (distinct) cell; every other entry gets

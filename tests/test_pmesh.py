@@ -184,3 +184,22 @@ def test_paint_sorted_max_collision_exact():
         assert err < 1e-5, (rs, err)
         # total mass conserved
         assert abs(float(np.asarray(got, 'f8').sum()) - 5000) < 1.0
+
+
+def test_paint_method_device_count_invariance(method='sort'):
+    """The sort kernel produces device-count-invariant fields through
+    the full exchange + halo path (the scatter kernel's invariance is
+    test_paint_device_count_invariance above)."""
+    from nbodykit_tpu import set_options
+
+    rng = np.random.RandomState(13)
+    pos_np = rng.uniform(0, 50.0, size=(3000, 3))
+    fields = []
+    with set_options(paint_method=method):
+        for comm in [cpu_mesh(1), cpu_mesh()]:
+            pm = ParticleMesh(32, 50.0, dtype='f8', comm=comm)
+            field = pm.paint(jnp.asarray(pos_np), 1.0, resampler='tsc')
+            fields.append(np.asarray(field))
+    np.testing.assert_allclose(fields[0], fields[1], rtol=1e-10,
+                               atol=1e-12)
+    np.testing.assert_allclose(fields[0].sum(), 3000.0, rtol=1e-9)
