@@ -339,3 +339,29 @@ def test_hearin15_decorated_hod():
     with pytest.warns(UserWarning, match="no 'Concentration'"):
         cat2 = HODModel(occupation=m, seed=11).populate(bare)
     assert len(np.asarray(cat2['Position'])) > 0
+
+
+@pytest.mark.slow
+def test_fftrecon_all_schemes():
+    """LF2 and LRR schemes run and agree with LGS at large scales
+    (reference fftrecon.py:172-215 scheme composition)."""
+    from nbodykit_tpu.algorithms.fftrecon import FFTRecon
+
+    Plin = LinearPower(Planck15, 0.0, transfer='EisensteinHu')
+    data = LogNormalCatalog(Plin=Plin, nbar=2e-3, BoxSize=200.,
+                            Nmesh=32, bias=1.5, seed=21)
+    ran = UniformCatalog(nbar=8e-3, BoxSize=200., seed=22)
+    fields = {}
+    for scheme in ('LGS', 'LF2', 'LRR'):
+        recon = FFTRecon(data, ran, Nmesh=32, bias=1.5, R=15.0,
+                         scheme=scheme)
+        val = np.asarray(recon.compute(mode='real').value)
+        assert np.isfinite(val).all(), scheme
+        assert abs(val.mean()) < 0.05, scheme
+        fields[scheme] = val
+    # schemes differ in detail but correlate strongly at this scale
+    for other in ('LF2', 'LRR'):
+        a, b = fields['LGS'].ravel(), fields[other].ravel()
+        rho = np.corrcoef(a, b)[0, 1]
+        assert rho > 0.8, (other, rho)
+    assert not np.array_equal(fields['LGS'], fields['LF2'])
