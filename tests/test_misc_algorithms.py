@@ -359,9 +359,15 @@ def test_fftrecon_all_schemes():
         assert np.isfinite(val).all(), scheme
         assert abs(val.mean()) < 0.05, scheme
         fields[scheme] = val
-    # schemes differ in detail but correlate strongly at this scale
+    # exact scheme identity (reference fftrecon.py:194-199):
+    # LF2 = 3/7 LGS + 4/7 LRR
+    np.testing.assert_allclose(
+        fields['LF2'], 3.0 / 7.0 * fields['LGS']
+        + 4.0 / 7.0 * fields['LRR'], rtol=1e-4, atol=1e-5)
+    # all schemes estimate the same underlying field: positively
+    # correlated, but not identical
     for other in ('LF2', 'LRR'):
-        a, b = fields['LGS'].ravel(), fields[other].ravel()
-        rho = np.corrcoef(a, b)[0, 1]
-        assert rho > 0.8, (other, rho)
+        rho = np.corrcoef(fields['LGS'].ravel(),
+                          fields[other].ravel())[0, 1]
+        assert rho > 0.5, (other, rho)
     assert not np.array_equal(fields['LGS'], fields['LF2'])
