@@ -88,11 +88,13 @@ def CartesianToSky(pos, cosmo, velocity=None, observer=[0, 0, 0],
     z = jnp.interp(r, jnp.asarray(rgrid), jnp.asarray(zgrid))
 
     if velocity is not None:
+        # the returned z is the OBSERVED redshift including the
+        # line-of-sight peculiar velocity (reference transform.py:
+        # 238-241 folds vpec into z; it does not add a 4th output)
         velocity = jnp.asarray(velocity)
         rhat = pos / jnp.where(r == 0, 1.0, r)[..., None]
         vpec = (velocity * rhat).sum(axis=-1)
-        z_rsd = z + vpec / 299792.458 * (1 + z)
-        return ra, dec, z, z_rsd
+        z = z + vpec / 299792.458 * (1 + z)
     return ra, dec, z
 
 

@@ -1,0 +1,102 @@
+"""Transform-module tests: sky<->cartesian round trips and column
+helpers (reference: nbodykit/tests/test_transform.py — the astropy
+cross-checks become self-consistency oracles here, since astropy is
+not installed)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from nbodykit_tpu import transform
+from nbodykit_tpu.cosmology import Planck15
+
+
+def _random_sky(n, seed=0, zmax=1.5):
+    rng = np.random.RandomState(seed)
+    ra = rng.uniform(0.0, 360.0, n)
+    dec = np.degrees(np.arcsin(rng.uniform(-0.99, 0.99, n)))
+    z = rng.uniform(0.01, zmax, n)
+    return ra, dec, z
+
+
+def test_sky_to_unit_sphere_unit_norm():
+    ra, dec, _ = _random_sky(500, seed=1)
+    v = np.asarray(transform.SkyToUnitSphere(ra, dec))
+    np.testing.assert_allclose(np.linalg.norm(v, axis=1), 1.0,
+                               rtol=1e-6)
+    # dec=+90 is the +z pole
+    pole = np.asarray(transform.SkyToUnitSphere([10.0], [90.0]))
+    np.testing.assert_allclose(pole[0], [0, 0, 1], atol=1e-6)
+
+
+def test_sky_cartesian_round_trip():
+    """CartesianToSky(SkyToCartesian(ra, dec, z)) == (ra, dec, z)."""
+    ra, dec, z = _random_sky(300, seed=2)
+    pos = transform.SkyToCartesian(ra, dec, z, Planck15)
+    ra2, dec2, z2 = transform.CartesianToSky(pos, Planck15)
+    np.testing.assert_allclose(np.mod(np.asarray(ra2), 360.0),
+                               np.mod(ra, 360.0), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dec2), dec, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(z2), z, rtol=1e-4)
+
+
+def test_cartesian_to_equatorial_matches_sky():
+    """CartesianToEquatorial agrees with the (ra, dec) of
+    CartesianToSky for the same observer."""
+    ra, dec, z = _random_sky(200, seed=3)
+    pos = transform.SkyToCartesian(ra, dec, z, Planck15)
+    ra_e, dec_e = transform.CartesianToEquatorial(pos)
+    np.testing.assert_allclose(np.mod(np.asarray(ra_e), 360.0),
+                               np.mod(ra, 360.0), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dec_e), dec, atol=1e-4)
+
+
+def test_cartesian_to_sky_with_velocity_rsd():
+    """velocity= shifts the apparent redshift along the line of
+    sight (reference transform.py:179 observed redshift)."""
+    ra, dec, z = _random_sky(100, seed=4, zmax=0.5)
+    pos = transform.SkyToCartesian(ra, dec, z, Planck15)
+    vel = np.zeros((100, 3))
+    _, _, z_norsd = transform.CartesianToSky(pos, Planck15)
+    _, _, z_rsd = transform.CartesianToSky(pos, Planck15,
+                                           velocity=vel)
+    np.testing.assert_allclose(np.asarray(z_rsd),
+                               np.asarray(z_norsd), rtol=1e-6)
+    # outward radial velocity increases observed z
+    unit = np.asarray(pos) / np.linalg.norm(np.asarray(pos),
+                                            axis=1)[:, None]
+    _, _, z_out = transform.CartesianToSky(pos, Planck15,
+                                           velocity=300.0 * unit)
+    assert (np.asarray(z_out) > np.asarray(z_norsd)).all()
+
+
+def test_vector_projection():
+    v = np.array([[1.0, 2.0, 3.0], [0.0, 1.0, 0.0]])
+    proj = np.asarray(transform.VectorProjection(v, [0, 0, 1]))
+    np.testing.assert_allclose(proj, [[0, 0, 3], [0, 0, 0]],
+                               atol=1e-12)
+    # projection + rejection reconstructs the vector
+    rej = v - proj
+    np.testing.assert_allclose(rej[:, 2], 0.0, atol=1e-12)
+
+
+def test_stack_concatenate_constant():
+    a = jnp.arange(4.0)
+    b = jnp.arange(4.0) + 10
+    st = np.asarray(transform.StackColumns(a, b))
+    assert st.shape == (4, 2)
+    np.testing.assert_allclose(st[:, 1], np.arange(4.0) + 10)
+
+    c = np.asarray(transform.ConstantArray(3.5, 7))
+    np.testing.assert_allclose(c, 3.5)
+    assert len(c) == 7
+
+    from nbodykit_tpu.lab import ArrayCatalog
+    c1 = ArrayCatalog({'x': np.arange(3.0)})
+    c2 = ArrayCatalog({'x': np.arange(5.0)})
+    cc = transform.ConcatenateSources(c1, c2)
+    assert cc.size == 8
+    np.testing.assert_allclose(np.asarray(cc['x'])[3:],
+                               np.arange(5.0))
