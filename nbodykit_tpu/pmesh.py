@@ -431,3 +431,58 @@ class ParticleMesh(object):
         """A new ParticleMesh with a different resolution, same box/mesh
         (reference: pm.reshape at base/mesh.py:320, for resampling)."""
         return ParticleMesh(Nmesh, self.BoxSize, self.dtype, self.comm)
+
+
+def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
+                paint_method='scatter', paint_chunk=None,
+                hbm_bytes=16e9):
+    """Estimated peak per-device HBM for the FFTPower pipeline
+    (paint -> rFFT -> |delta_k|^2 -> chunked binning) — the arithmetic
+    behind chunk-size choices and the BASELINE.md scale claims
+    (Nmesh=1024/1e8 on one v5e chip; Nmesh=2048/1e9 on v5e-16).
+
+    Returns a dict of per-phase byte estimates, ``peak_bytes``, and
+    ``fits`` (vs ``hbm_bytes``, 16 GB v5e default, with a 15%
+    allocator margin). Estimates, not guarantees — XLA's actual
+    buffers vary; the model errs high on the FFT workspace (2x the
+    complex field for the out-of-place transposed passes).
+    """
+    N = _triplet(Nmesh, 'i8')
+    ndev = max(int(ndevices), 1)
+    item = np.dtype(dtype).itemsize
+    ncells = float(np.prod(N))
+    s = window_support(resampler or 'cic')
+
+    real = item * ncells / ndev
+    cplx = 2 * item * (N[0] * N[1] * (N[2] // 2 + 1)) / ndev
+    fft_ws = 2 * cplx
+    pos_b = 3 * item * npart / ndev
+    if paint_chunk is None:
+        chunk = _global_options['paint_chunk_size']
+    else:
+        chunk = paint_chunk
+    live = min(npart / ndev, chunk)
+    if paint_method == 'sort':
+        # all s^3 deposit terms live at once: (key i32 + val) pairs,
+        # doubled by the sort's out-of-place buffers
+        paint_tmp = (s ** 3) * (4 + item) * (npart / ndev) * 2
+    else:
+        paint_tmp = (s ** 3) * (4 + item) * live
+    p3 = cplx / 2               # |delta_k|^2 as real of the half-spec
+    phases = {
+        'real_field': real,
+        'complex_field': cplx,
+        'fft_workspace': fft_ws,
+        'positions': pos_b,
+        'paint_temporaries': paint_tmp,
+        'power3d': p3,
+    }
+    # paint phase: field + positions + temporaries;
+    # fft phase: real + complex + workspace (positions still resident
+    # unless donated); binning adds only O(chunk) slabs
+    peak = max(real + pos_b + paint_tmp,
+               real + cplx + fft_ws + pos_b,
+               cplx + p3 + pos_b)
+    phases['peak_bytes'] = peak
+    phases['fits'] = bool(peak <= 0.85 * hbm_bytes)
+    return phases

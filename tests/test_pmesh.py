@@ -203,3 +203,22 @@ def test_paint_method_device_count_invariance(method='sort'):
     np.testing.assert_allclose(fields[0], fields[1], rtol=1e-10,
                                atol=1e-12)
     np.testing.assert_allclose(fields[0].sum(), 3000.0, rtol=1e-9)
+
+
+def test_memory_plan_scale_claims():
+    """The HBM arithmetic behind BASELINE.md: the v5e-16 stretch config
+    fits per device, the single-chip 2048 does not, and small configs
+    are comfortable (pmesh.memory_plan)."""
+    from nbodykit_tpu.pmesh import memory_plan
+
+    assert memory_plan(512, int(1e7), 1)['fits']
+    assert not memory_plan(2048, int(1e9), 1)['fits']
+    p16 = memory_plan(2048, int(1e9), 16)
+    assert p16['fits'] and p16['peak_bytes'] < 10e9
+    # monotonic in devices
+    assert (memory_plan(1024, int(1e8), 8)['peak_bytes']
+            < memory_plan(1024, int(1e8), 1)['peak_bytes'])
+    # sort paint costs more than chunked scatter at large npart
+    assert (memory_plan(1024, int(1e8), 1, paint_method='sort')
+            ['paint_temporaries']
+            > memory_plan(1024, int(1e8), 1)['paint_temporaries'])
