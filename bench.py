@@ -295,8 +295,12 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         rec['mode'] = 'staged'
         s_paint = jax.jit(lambda p: phase_fns['paint'](p)
                           / (Npart / pm.Ntot))
-        s_power = jax.jit(phase_fns['field_power'])
-        s_bin = jax.jit(phase_fns['binning'])
+        # donate the field into the FFT and p3 into the binning: at
+        # Nmesh=1024 the real field is ~4.3 GB and the staged peak is
+        # workspace-bound (see pmesh.memory_plan) — reusing the input
+        # buffers is the difference between fitting v5e HBM and OOM
+        s_power = jax.jit(phase_fns['field_power'], donate_argnums=0)
+        s_bin = jax.jit(phase_fns['binning'], donate_argnums=0)
         t0 = time.time()
         field = s_paint(pos)
         p3 = s_power(field)
