@@ -205,12 +205,12 @@ class BinnedStatistic(object):
         return new
 
     def rename_variable(self, old_name, new_name):
+        """Rename a variable IN-PLACE (reference semantics,
+        binned_statistic.py 'performed in-place'); returns None."""
         if old_name not in self._vars:
             raise ValueError("no variable named %r" % old_name)
-        new = self.copy()
-        new._vars = {(new_name if k == old_name else k): v
-                     for k, v in new._vars.items()}
-        return new
+        self._vars = {(new_name if k == old_name else k): v
+                      for k, v in self._vars.items()}
 
     def _take_indices(self, indices):
         """New instance keeping the given per-dimension index arrays

@@ -92,8 +92,11 @@ def test_json_roundtrip(tmp_path):
 
 def test_rename_and_setitem():
     ds = make_2d()
-    ds2 = ds.rename_variable('power', 'corr')
-    assert 'corr' in ds2.variables and 'power' not in ds2.variables
+    # in-place, like the reference (binned_statistic.py rename docs)
+    ds.rename_variable('power', 'corr')
+    assert 'corr' in ds.variables and 'power' not in ds.variables
+    with pytest.raises(ValueError):
+        ds.rename_variable('nope', 'x')
     ds['extra'] = np.ones(ds.shape)
     assert 'extra' in ds.variables
     with pytest.raises(ValueError):
