@@ -177,59 +177,119 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     period = tuple(int(p) for p in period)
     n = pos.shape[0]
     M = n0l * N1 * N2
+    s = window_support(resampler)
     dtype = out.dtype if out is not None else (
         mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
     mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
 
-    lins, ws = [], []
-    for lin, w in _offset_terms(pos, mass, resampler, period, origin,
-                                n0l):
-        lins.append(lin.astype(jnp.int32))
-        ws.append(w.astype(dtype))
-    keys = jnp.concatenate(lins)
-    vals = jnp.concatenate(ws)
-    keys, vals = jax.lax.sort((keys, vals), num_keys=1)
+    # ONE sort, of the n base cells (not the s^3*n deposit terms): for
+    # every window offset (a,b,c) the un-wrapped deposit key is the
+    # base key plus the constant d=(a*N1+b)*N2+c, so base order keeps
+    # equal deposit keys contiguous for every offset simultaneously,
+    # and the segment structure (run boundaries) is SHARED — wrap
+    # status and cell indices are functions of the base cell alone.
+    i0, w0 = _axis_terms(pos[:, 0], resampler, period[0])
+    i1, w1 = _axis_terms(pos[:, 1], resampler, period[1])
+    i2, w2 = _axis_terms(pos[:, 2], resampler, period[2])
+    row0 = jnp.mod(i0[:, 0] - origin, period[0]).astype(jnp.int32)
+    valid0 = row0 < n0l
+    lin_base = ((jnp.where(valid0, row0, 0) * N1
+                 + i1[:, 0].astype(jnp.int32)) * N2
+                + i2[:, 0].astype(jnp.int32))
+    order = jnp.argsort(lin_base)
+    i0s, i1s, i2s = i0[order], i1[order], i2[order]
+    w0s = w0[order].astype(dtype)
+    w1s = w1[order].astype(dtype)
+    w2s = w2[order].astype(dtype)
+    ms = mass[order]
+    keys = lin_base[order]
+    row0s, valid0s = row0[order], valid0[order]
 
-    # segmented inclusive prefix sums via doubling shift-add passes:
-    # afterwards the last element of each equal-key run holds the run
-    # total. Dynamic shifts use index arithmetic (gathers) so the loop
-    # can run until no run spans the current shift.
-    total = keys.shape[0]
-    idx = jnp.arange(total, dtype=jnp.int32)
-    max_shift = total if npasses is None else min(total, 1 << npasses)
-
-    def cond(state):
-        vals, shift, active = state
-        return active & (shift < max_shift)
-
-    def body(state):
-        vals, shift, _ = state
-        src = jnp.maximum(idx - shift, 0)
-        same = (idx >= shift) & (keys == keys[src])
-        vals = vals + jnp.where(same, vals[src], 0)
-        # another pass is needed iff some run still spans 2*shift
-        src2 = jnp.maximum(idx - 2 * shift, 0)
-        active = jnp.any((idx >= 2 * shift) & (keys == keys[src2]))
-        return vals, shift * 2, active
-
-    # initial 'active' must be derived from the (device-varying) data:
-    # a literal True has an unvarying vma type under shard_map and the
-    # while_loop carry then type-mismatches the body's data-derived
-    # output (always True in value — every nonempty sort may need a
-    # pass)
-    active0 = jnp.any(keys == keys)
-    vals, _, _ = jax.lax.while_loop(
-        cond, body, (vals, jnp.int32(1), active0))
-
-    # one scatter with provably unique indices: run-end entries carry
-    # their run's total to its (distinct) cell; every other entry gets
-    # a distinct out-of-bounds index and is dropped
-    is_last = jnp.concatenate(
-        [keys[1:] != keys[:-1], jnp.ones((1,), bool)])
-    skeys = jnp.where(is_last, keys, M + idx)
-    svals = jnp.where(is_last, vals, 0)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_last = jnp.concatenate([keys[1:] != keys[:-1],
+                               jnp.ones((1,), bool)]) if n else \
+        jnp.zeros((0,), bool)
+    # dropped-slot sentinel base: strictly above every possible
+    # keys + d (d <= (s-1)*(N1*N2+N2+1)), so sentinels can never
+    # collide with a wrapped run's out-of-block key + d
+    sent = M + (s - 1) * (N1 * N2 + N2 + 1) + 1
 
     flat = jnp.zeros(M, dtype=dtype) if out is None else \
         jnp.asarray(out).reshape(-1)
-    flat = flat.at[skeys].add(svals, mode='drop', unique_indices=True)
+
+    # per-offset deposit values, exact keys, and wrap status — all in
+    # base-sorted order. Entries that wrap (periodic boundary) or fall
+    # outside the local block break the constant-shift relation and go
+    # through a small plain scatter instead.
+    offs, wsegs, fb_keys, fb_vals = [], [], [], []
+    for a in range(s):
+        rowa = jnp.mod(i0s[:, a].astype(jnp.int32) - origin,
+                       period[0])
+        valida = rowa < n0l
+        for b in range(s):
+            for c in range(s):
+                d = (a * N1 + b) * N2 + c
+                w = w0s[:, a] * w1s[:, b] * w2s[:, c] * ms
+                lin = ((jnp.where(valida, rowa, 0) * N1
+                        + i1s[:, b].astype(jnp.int32)) * N2
+                       + i2s[:, c].astype(jnp.int32))
+                unwrapped = (valida & valid0s
+                             & (rowa == row0s + a)
+                             & (i1s[:, b] == i1s[:, 0] + b)
+                             & (i2s[:, c] == i2s[:, 0] + c))
+                offs.append(d)
+                wsegs.append(jnp.where(unwrapped, w, 0))
+                # fallback stream: wrapped in-block deposits (the
+                # periodic boundary strip). The stream is s^3*n wide
+                # (XLA cannot elide masked updates) but only the
+                # O(n*s^3/N) boundary entries carry weight; masked
+                # slots get DISTINCT out-of-bounds indices so they do
+                # not pile up on one colliding index. (If sent+j*n+idx
+                # wraps int32 at extreme M*s^3*n, a masked slot may
+                # alias an in-bounds cell — harmless: its value is 0.)
+                fb = unwrapped | ~valida
+                j = len(offs) - 1
+                fb_keys.append(jnp.where(fb, sent + j * n + idx, lin))
+                fb_vals.append(jnp.where(fb, 0, w))
+
+    if fb_keys:
+        flat = flat.at[jnp.concatenate(fb_keys)].add(
+            jnp.concatenate(fb_vals), mode='drop')
+
+    # shared segmented inclusive prefix sum, vectorized over the s^3
+    # offsets: doubling shift-add passes; afterwards the last element
+    # of each run holds the run total. Exact — no global cumsum, f32
+    # precision preserved.
+    W = jnp.stack(wsegs)                      # (s^3, n)
+    max_shift = n if npasses is None else min(n, 1 << npasses)
+
+    def cond(state):
+        W, shift, active = state
+        return active & (shift < max_shift)
+
+    def body(state):
+        W, shift, _ = state
+        src = jnp.maximum(idx - shift, 0)
+        same = (idx >= shift) & (keys == keys[src])
+        W = W + jnp.where(same[None, :], W[:, src], 0)
+        src2 = jnp.maximum(idx - 2 * shift, 0)
+        active = jnp.any((idx >= 2 * shift) & (keys == keys[src2]))
+        return W, shift * 2, active
+
+    # data-derived initial 'active' (vma-varying under shard_map; a
+    # literal True would type-mismatch the while_loop carry)
+    active0 = jnp.any(keys == keys)
+    W, _, _ = jax.lax.while_loop(cond, body,
+                                 (W, jnp.int32(1), active0))
+
+    # one provably-unique scatter per offset: run-end entries carry
+    # their run total to base_key + d; all others get distinct
+    # out-of-bounds indices and are dropped
+    for j, d in enumerate(offs):
+        # run-end keys+d are distinct (distinct run keys, same d) and
+        # a wrapped run's key+d stays below `sent`, so the sentinel
+        # slots keep the uniqueness claim honest even then
+        skeys = jnp.where(is_last, keys + d, sent + idx)
+        flat = flat.at[skeys].add(jnp.where(is_last, W[j], 0),
+                                  mode='drop', unique_indices=True)
     return flat.reshape(shape)
