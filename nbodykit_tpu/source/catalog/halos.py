@@ -107,8 +107,16 @@ class HaloCatalog(CatalogSource):
         here nbodykit_tpu.hod natively)."""
         from ...hod import HODModel, Zheng07Model
         if model is None:
-            model = HODModel(Zheng07Model(**params), seed=seed)
-        elif not isinstance(model, HODModel):
+            model = Zheng07Model(**params)
+        elif isinstance(model, type):
+            # an occupation CLASS (e.g. populate(Zheng07Model,
+            # logMmin=...)): instantiate it with the HOD parameters
+            model = model(**params)
+        elif params:
+            raise ValueError(
+                "HOD parameters can only be passed with an occupation "
+                "class (got an instance of %s)" % type(model).__name__)
+        if not isinstance(model, HODModel):
             model = HODModel(model, seed=seed)
         return model.populate(self, seed=seed)
 

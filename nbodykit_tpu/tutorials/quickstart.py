@@ -1,0 +1,111 @@
+"""Executable quickstart: every docs/EXAMPLES.md flow at test scale.
+
+Run it directly (``python -m nbodykit_tpu.tutorials.quickstart``) or
+through ``run_all(scale=...)``; each step returns its headline result
+so the test suite can execute the whole cookbook
+(tests/test_misc_algorithms.py::test_quickstart_cookbook).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def run_all(Nmesh=32, BoxSize=200.0, verbose=False):
+    """Run the cookbook end-to-end at the given scale; returns a dict
+    of step name -> summary value (all finite when healthy)."""
+    from ..lab import (UniformCatalog, LogNormalCatalog, LinearPower,
+                       Planck15, FFTPower, FFTCorr, FKPCatalog,
+                       ConvolvedFFTPower, FFTRecon, FOF,
+                       SimulationBox2PCF, Zheng07Model, BigFileCatalog,
+                       TaskManager, CorrelationFunction, HalofitPower)
+    import tempfile
+    import os
+
+    out = {}
+
+    def log(step, value):
+        out[step] = value
+        if verbose:
+            print('%-22s %s' % (step, value))
+
+    # 1. lognormal mock -> P(k, mu) + poles
+    Plin = LinearPower(Planck15, redshift=0.55,
+                       transfer='EisensteinHu')
+    cat = LogNormalCatalog(Plin=Plin, nbar=3e-4, BoxSize=BoxSize,
+                           Nmesh=Nmesh, bias=2.0, seed=42)
+    mesh = cat.to_mesh(resampler='tsc', compensated=True,
+                       interlaced=True)
+    r = FFTPower(mesh, mode='2d', Nmu=5, poles=[0, 2])
+    log('fftpower_p0', float(np.real(
+        np.asarray(r.poles['power_0'])[2])))
+
+    # 2. save / load round trip
+    tmp = tempfile.mkdtemp()
+    fn = os.path.join(tmp, 'power.json')
+    r.save(fn)
+    r2 = FFTPower.load(fn)
+    log('roundtrip_ok', bool(np.allclose(
+        np.asarray(r.power['power'].real),
+        np.asarray(r2.power['power'].real), equal_nan=True)))
+
+    # 3. FKP survey multipoles
+    data = UniformCatalog(nbar=3e-4, BoxSize=BoxSize, seed=1)
+    randoms = UniformCatalog(nbar=3e-3, BoxSize=BoxSize, seed=2)
+    for c in (data, randoms):
+        c['NZ'] = 3e-4 * jnp.ones(c.size)
+    rf = ConvolvedFFTPower(FKPCatalog(data, randoms).to_mesh(
+        Nmesh=Nmesh, resampler='tsc'), poles=[0, 2], dk=0.05)
+    log('fkp_p0', float(np.real(np.asarray(
+        rf.poles['power_0'])).mean()))
+
+    # 4. FOF halos -> HOD population
+    fof = FOF(cat, linking_length=0.2, nmin=8)
+    halos = fof.to_halos(particle_mass=1e13, cosmo=Planck15,
+                         redshift=0.55)
+    log('n_halos', int(halos.size))
+    if halos.size:
+        hod = halos.populate(Zheng07Model, seed=42, logMmin=12.5)
+        log('n_hod', int(hod.size))
+
+    # 5. correlation functions
+    xi = FFTCorr(cat.to_mesh(Nmesh=Nmesh, compensated=True),
+                 mode='1d')
+    log('fftcorr_xi0', float(np.real(
+        np.asarray(xi.corr['corr'])[1])))
+    edges = np.linspace(5.0, 25.0, 6)
+    tpcf = SimulationBox2PCF('1d', cat, edges)
+    log('tpcf_xi0', float(np.asarray(tpcf.corr['corr'])[0]))
+
+    # 6. BAO reconstruction
+    recon = FFTRecon(data=cat, ran=randoms, Nmesh=Nmesh, bias=2.0,
+                     R=15.0, scheme='LGS')
+    log('recon_mean', float(np.asarray(
+        recon.compute(mode='real').value).mean()))
+
+    # 7. IO round trip through bigfile
+    path = os.path.join(tmp, 'cat.bigfile')
+    cat.save(path, columns=['Position', 'Velocity'])
+    back = BigFileCatalog(path)
+    log('bigfile_ok', bool(back.size == cat.size))
+
+    # 8. task farming over seeds
+    with TaskManager(cpus_per_task=1) as tm:
+        p0s = []
+        for seed in tm.iterate([9, 10]):
+            c = UniformCatalog(nbar=2e-3, BoxSize=100.0, seed=seed)
+            rr = FFTPower(c.to_mesh(Nmesh=16), mode='1d')
+            p0s.append(float(np.real(
+                np.asarray(rr.power['power'])[1])))
+    log('farmed', len(p0s))
+
+    # 9. cosmology
+    log('sigma8', float(Planck15.sigma8))
+    log('halofit_ok', float(HalofitPower(Planck15, 0.5)(0.1)) > 0)
+    log('xi_of_r', float(CorrelationFunction(Plin)(80.0)))
+
+    return out
+
+
+if __name__ == '__main__':
+    for k, v in run_all(verbose=True).items():
+        pass

@@ -371,3 +371,19 @@ def test_fftrecon_all_schemes():
                           fields[other].ravel())[0, 1]
         assert rho > 0.5, (other, rho)
     assert not np.array_equal(fields['LGS'], fields['LF2'])
+
+
+@pytest.mark.slow
+def test_quickstart_cookbook():
+    """The executable cookbook (tutorials/quickstart.py) runs every
+    docs/EXAMPLES.md flow end-to-end with finite results."""
+    from nbodykit_tpu.tutorials.quickstart import run_all
+
+    out = run_all()
+    assert len(out) >= 12
+    for k, v in out.items():
+        if isinstance(v, float):
+            assert np.isfinite(v), (k, v)
+    assert out['roundtrip_ok'] and out['bigfile_ok']
+    assert out['farmed'] == 2
+    assert abs(out['sigma8'] - 0.8159) < 0.01
