@@ -1,7 +1,8 @@
 """Executable quickstart: every docs/EXAMPLES.md flow at test scale.
 
 Run it directly (``python -m nbodykit_tpu.tutorials.quickstart``) or
-through ``run_all(scale=...)``; each step returns its headline result
+through ``run_all(Nmesh=..., BoxSize=...)``; each step returns its
+headline result
 so the test suite can execute the whole cookbook
 (tests/test_misc_algorithms.py::test_quickstart_cookbook).
 """
@@ -18,8 +19,9 @@ def run_all(Nmesh=32, BoxSize=200.0, verbose=False):
                        ConvolvedFFTPower, FFTRecon, FOF,
                        SimulationBox2PCF, Zheng07Model, BigFileCatalog,
                        TaskManager, CorrelationFunction, HalofitPower)
-    import tempfile
     import os
+    import shutil
+    import tempfile
 
     out = {}
 
@@ -40,7 +42,7 @@ def run_all(Nmesh=32, BoxSize=200.0, verbose=False):
         np.asarray(r.poles['power_0'])[2])))
 
     # 2. save / load round trip
-    tmp = tempfile.mkdtemp()
+    tmp = tempfile.mkdtemp(prefix='nbkit_quickstart_')
     fn = os.path.join(tmp, 'power.json')
     r.save(fn)
     r2 = FFTPower.load(fn)
@@ -103,9 +105,9 @@ def run_all(Nmesh=32, BoxSize=200.0, verbose=False):
     log('halofit_ok', float(HalofitPower(Planck15, 0.5)(0.1)) > 0)
     log('xi_of_r', float(CorrelationFunction(Plin)(80.0)))
 
+    shutil.rmtree(tmp, ignore_errors=True)
     return out
 
 
 if __name__ == '__main__':
-    for k, v in run_all(verbose=True).items():
-        pass
+    run_all(verbose=True)

@@ -385,5 +385,7 @@ def test_quickstart_cookbook():
         if isinstance(v, float):
             assert np.isfinite(v), (k, v)
     assert out['roundtrip_ok'] and out['bigfile_ok']
+    # the populate(OccupationClass, **params) path must actually run
+    assert out['n_halos'] > 0 and 'n_hod' in out
     assert out['farmed'] == 2
     assert abs(out['sigma8'] - 0.8159) < 0.01
