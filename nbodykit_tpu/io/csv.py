@@ -28,34 +28,52 @@ class CSVFile(FileType):
                  delim_whitespace=True, **config):
         import pandas as pd
         self.path = path
+        # parse with the FULL name list (pandas aligns names to file
+        # columns); usecols only selects what this file EXPOSES
+        self._all_names = list(names)
         self._names = list(names)
         if usecols is not None:
-            self._names = [n for n in self._names if n in usecols]
+            self._names = [n for n in self._all_names if n in usecols]
         if isinstance(dtype, dict):
             dt = [(n, dtype.get(n, 'f8')) for n in self._names]
         else:
             dt = [(n, dtype) for n in self._names]
         self.dtype = np.dtype(dt)
         self._config = dict(config)
+        # skiprows/nrows are partitioning-reserved in read(); user
+        # values restrict the file's logical extent instead
+        user_skip = self._config.pop('skiprows', 0)
+        user_nrows = self._config.pop('nrows', None)
         self._config.setdefault('comment', '#')
         if delim_whitespace:
             self._config.setdefault('sep', r'\s+')
         self._pd = pd
 
-        # count rows once (cheap single pass)
+        # one scan: physical line index of every data row, so
+        # partitioned reads stay aligned across comments/blank lines
+        comment = self._config['comment'].encode()
+        lines = []
         with open(path, 'rb') as ff:
-            comment = self._config['comment']
-            self.size = sum(
-                1 for line in ff
+            for i, line in enumerate(ff):
                 if line.strip() and not line.lstrip().startswith(
-                    comment.encode()))
+                        comment):
+                    lines.append(i)
+        row_lines = np.asarray(lines, dtype='i8')
+        row_lines = row_lines[row_lines >= int(user_skip)]
+        if user_nrows is not None:
+            row_lines = row_lines[:int(user_nrows)]
+        self._row_lines = row_lines
+        self.size = len(row_lines)
 
     def read(self, columns, start, stop, step=1):
-        df = self._pd.read_csv(
-            self.path, names=list(self._names), header=None,
-            skiprows=start, nrows=stop - start, usecols=None,
-            **self._config)
         out = self._empty(columns, len(range(start, stop, step)))
+        if stop <= start:
+            return out
+        df = self._pd.read_csv(
+            self.path, names=list(self._all_names), header=None,
+            skiprows=int(self._row_lines[start]),
+            nrows=stop - start,  # pandas nrows counts PARSED rows
+            usecols=list(self._names), **self._config)
         for col in columns:
             out[col] = df[col].to_numpy()[::step].astype(self.dtype[col])
         return out
