@@ -41,7 +41,9 @@ class CSVFile(FileType):
         self.dtype = np.dtype(dt)
         self._config = dict(config)
         # skiprows/nrows are partitioning-reserved in read(); user
-        # values restrict the file's logical extent instead
+        # values restrict the file's logical extent instead. An int
+        # skiprows drops leading physical lines (pandas semantics); a
+        # list drops those specific physical lines.
         user_skip = self._config.pop('skiprows', 0)
         user_nrows = self._config.pop('nrows', None)
         self._config.setdefault('comment', '#')
@@ -49,31 +51,67 @@ class CSVFile(FileType):
             self._config.setdefault('sep', r'\s+')
         self._pd = pd
 
-        # one scan: physical line index of every data row, so
-        # partitioned reads stay aligned across comments/blank lines
-        comment = self._config['comment'].encode()
-        lines = []
+        # one scan recording only the NON-data line offsets (comments,
+        # blanks, user-skipped): logical->physical row mapping is then
+        # O(#non-data-lines) memory via searchsorted, not one entry
+        # per data row
+        comment = self._config['comment']
+        comment_b = comment.encode() if comment is not None else None
+        skip_set = set() if np.isscalar(user_skip) else \
+            set(int(i) for i in user_skip)
+        skip_n = int(user_skip) if np.isscalar(user_skip) else 0
+        bad = []
+        total = 0
         with open(path, 'rb') as ff:
             for i, line in enumerate(ff):
-                if line.strip() and not line.lstrip().startswith(
-                        comment):
-                    lines.append(i)
-        row_lines = np.asarray(lines, dtype='i8')
-        row_lines = row_lines[row_lines >= int(user_skip)]
+                total += 1
+                if (i < skip_n or i in skip_set
+                        or not line.strip()
+                        or (comment_b is not None
+                            and line.lstrip().startswith(comment_b))):
+                    bad.append(i)
+        self._bad_lines = np.asarray(bad, dtype='i8')
+        self.size = total - len(bad)
         if user_nrows is not None:
-            row_lines = row_lines[:int(user_nrows)]
-        self._row_lines = row_lines
-        self.size = len(row_lines)
+            self.size = min(self.size, int(user_nrows))
+        if skip_set:
+            # specific-line skips are not forwarded to pandas (they
+            # were consumed here); re-add as comment-free config
+            self._config['skiprows'] = sorted(skip_set)
+
+    def _phys(self, row):
+        """Physical line index of logical data row ``row``."""
+        p = int(row)
+        while True:
+            nb = int(np.searchsorted(self._bad_lines, p, side='right'))
+            p2 = int(row) + nb
+            if p2 == p:
+                return p
+            p = p2
 
     def read(self, columns, start, stop, step=1):
-        out = self._empty(columns, len(range(start, stop, step)))
-        if stop <= start:
+        if step == 0:
+            raise ValueError("step must be nonzero")
+        idx = np.arange(start, stop, step)
+        out = self._empty(columns, len(idx))
+        if idx.size == 0:
             return out
+        lo, hi = int(idx.min()), int(idx.max()) + 1
+        if not (0 <= lo and hi <= self.size):
+            raise IndexError(
+                "row range [%d, %d) outside file of size %d"
+                % (lo, hi, self.size))
+        cfg = dict(self._config)
+        extra_skip = cfg.pop('skiprows', [])
+        phys_lo = self._phys(lo)
+        skiprows = sorted(set([j for j in extra_skip if j >= phys_lo])
+                          | set(range(phys_lo)))
         df = self._pd.read_csv(
             self.path, names=list(self._all_names), header=None,
-            skiprows=int(self._row_lines[start]),
-            nrows=stop - start,  # pandas nrows counts PARSED rows
-            usecols=list(self._names), **self._config)
+            skiprows=skiprows,
+            nrows=hi - lo,  # pandas nrows counts PARSED rows
+            usecols=list(self._names), **cfg)
         for col in columns:
-            out[col] = df[col].to_numpy()[::step].astype(self.dtype[col])
+            vals = df[col].to_numpy()
+            out[col] = vals[idx - lo].astype(self.dtype[col])
         return out

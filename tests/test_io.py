@@ -335,3 +335,26 @@ def test_csv_reader_kwargs(tmp_path):
     np.testing.assert_allclose(ff.read(['b'], 2, 4)['b'], [8, 11])
     # usecols selects labeled columns correctly (not positionally)
     np.testing.assert_allclose(ff2.read(['b'], 1, 3)['b'], [5, 8])
+
+
+def test_csv_negative_step_and_mid_comments(tmp_path):
+    """Partitioned reads stay aligned across mid-file comments; the
+    slice contract supports negative steps and validates ranges."""
+    from nbodykit_tpu.io import CSVFile
+
+    fn = str(tmp_path / 'y.csv')
+    with open(fn, 'w') as f:
+        f.write("# c\n1,2\n3,4\n\n5,6\n# mid\n7,8\n9,10\n")
+    ff = CSVFile(fn, names=['a', 'b'], sep=',', comment='#')
+    assert ff.size == 5
+    np.testing.assert_allclose(ff.read(['a'], 3, 5)['a'], [7, 9])
+    np.testing.assert_allclose(ff[::-1]['a'], [9, 7, 5, 3, 1])
+    np.testing.assert_allclose(ff.read(['a'], 0, 5, 2)['a'],
+                               [1, 5, 9])
+    with pytest.raises(IndexError):
+        ff.read(['a'], -2, 2)
+    # list-valued skiprows drops those physical lines
+    f3 = CSVFile(fn, names=['a', 'b'], sep=',', comment='#',
+                 skiprows=[2])
+    np.testing.assert_allclose(f3.read(['a'], 0, f3.size)['a'],
+                               [1, 5, 7, 9])
