@@ -1,49 +1,190 @@
 """FITSFile: FITS binary-table reads.
 
-Reference: ``nbodykit/io/fits.py:8`` (fitsio-backed). fitsio is not in
-this environment; astropy.io.fits is used when available, else a clear
-ImportError at construction.
+Reference: ``nbodykit/io/fits.py:8`` (fitsio, a cfitsio binding).
+Neither fitsio nor astropy is guaranteed in this environment, so a
+built-in parser handles the standard numeric BINTABLE layout natively
+(FITS is 2880-byte header blocks of 80-char cards + a big-endian
+record array — no external dependency needed for the common case).
+astropy is preferred when importable (variable-length arrays, scaling,
+compressed HDUs).
 """
 
 import numpy as np
 
 from .base import FileType
 
+# TFORMn letter -> numpy big-endian dtype
+_TFORM = {'L': '?', 'B': 'u1', 'I': '>i2', 'J': '>i4', 'K': '>i8',
+          'E': '>f4', 'D': '>f8', 'A': 'S'}
+_BLOCK = 2880
 
-class FITSFile(FileType):
-    """FITS binary table reader (ext selects the HDU)."""
+
+def _read_header(ff):
+    """Parse one FITS header (cards until END, block-aligned); returns
+    (dict, data_offset_after_header)."""
+    cards = {}
+    while True:
+        block = ff.read(_BLOCK)
+        if len(block) < _BLOCK:
+            raise ValueError("truncated FITS header")
+        done = False
+        for i in range(0, _BLOCK, 80):
+            card = block[i:i + 80].decode('ascii', errors='replace')
+            key = card[:8].strip()
+            if key == 'END':
+                done = True
+                break
+            if not key or card[8] != '=':
+                continue
+            val = card[10:].split('/')[0].strip()
+            if val.startswith("'"):
+                cards[key] = val.strip("'").strip()
+            elif val in ('T', 'F'):
+                cards[key] = val == 'T'
+            else:
+                try:
+                    cards[key] = int(val)
+                except ValueError:
+                    try:
+                        cards[key] = float(val)
+                    except ValueError:
+                        cards[key] = val
+        if done:
+            return cards, ff.tell()
+
+
+def _parse_tform(tform):
+    """'1D', 'E', '3J', '10A' -> (repeat, letter)."""
+    i = 0
+    while i < len(tform) and tform[i].isdigit():
+        i += 1
+    repeat = int(tform[:i]) if i else 1
+    letter = tform[i:i + 1]
+    if letter not in _TFORM:
+        raise ValueError("unsupported TFORM %r" % tform)
+    return repeat, letter
+
+
+class _NativeFits(object):
+    """Minimal native BINTABLE backend: walks HDUs, exposes the first
+    (or requested) binary table as an on-disk big-endian recarray."""
 
     def __init__(self, path, ext=None):
+        self.path = path
+        with open(path, 'rb') as ff:
+            header, off = _read_header(ff)   # primary HDU
+            if not header.get('SIMPLE', False):
+                raise ValueError("not a FITS file (no SIMPLE card)")
+            hdu_index = 0
+            data_size = self._data_bytes(header)
+            while True:
+                ff.seek(off + self._padded(data_size))
+                header, off = _read_header(ff)
+                hdu_index += 1
+                data_size = self._data_bytes(header)
+                if header.get('XTENSION') == 'BINTABLE' and \
+                        (ext is None or ext == hdu_index):
+                    break
+                if ff.tell() + data_size >= self._file_size():
+                    raise ValueError("no binary table HDU found")
+        self.ext = hdu_index
+        self.header = header
+        self.data_start = off
+        self.nrows = int(header['NAXIS2'])
+        self.rowbytes = int(header['NAXIS1'])
+
+        fields = []
+        for i in range(1, int(header['TFIELDS']) + 1):
+            name = str(header.get('TTYPE%d' % i, 'col%d' % i)).strip()
+            repeat, letter = _parse_tform(str(header['TFORM%d' % i]))
+            if letter == 'A':
+                fields.append((name, 'S%d' % repeat))
+            elif repeat == 1:
+                fields.append((name, _TFORM[letter]))
+            else:
+                fields.append((name, _TFORM[letter], (repeat,)))
+        self.dtype_disk = np.dtype(fields)
+        if self.dtype_disk.itemsize != self.rowbytes:
+            raise ValueError(
+                "BINTABLE row size %d != dtype size %d (unsupported "
+                "TFORM layout)" % (self.rowbytes,
+                                   self.dtype_disk.itemsize))
+
+    def _file_size(self):
+        import os
+        return os.path.getsize(self.path)
+
+    @staticmethod
+    def _padded(n):
+        return ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+
+    @staticmethod
+    def _data_bytes(header):
+        if header.get('NAXIS', 0) == 0:
+            return 0
+        n = 1
+        for i in range(1, int(header['NAXIS']) + 1):
+            n *= int(header.get('NAXIS%d' % i, 0))
+        return n * abs(int(header.get('BITPIX', 8))) // 8 \
+            * int(header.get('GCOUNT', 1)) + int(header.get('PCOUNT', 0))
+
+    def read_rows(self, start, stop):
+        with open(self.path, 'rb') as ff:
+            ff.seek(self.data_start + start * self.rowbytes)
+            raw = ff.read((stop - start) * self.rowbytes)
+        return np.frombuffer(raw, dtype=self.dtype_disk)
+
+
+class FITSFile(FileType):
+    """FITS binary table reader (ext selects the HDU). Uses astropy
+    when importable, else the built-in native BINTABLE parser."""
+
+    def __init__(self, path, ext=None):
+        self.path = path
         try:
             from astropy.io import fits
+            self._backend = 'astropy'
         except ImportError:
-            try:
-                import fitsio  # noqa: F401
-            except ImportError:
-                raise ImportError(
-                    "reading FITS requires astropy or fitsio; neither "
-                    "is available in this environment")
-        self.path = path
-        with fits.open(path) as hdus:
-            if ext is None:
-                for i, hdu in enumerate(hdus):
-                    if getattr(hdu, 'data', None) is not None and \
-                            getattr(hdu, 'columns', None) is not None:
-                        ext = i
-                        break
-            if ext is None:
-                raise ValueError("no binary table HDU found")
-            self.ext = ext
-            data = hdus[ext].data
-            self.size = len(data)
-            self.dtype = data.dtype
-            self.attrs = dict(hdus[ext].header)
+            self._backend = 'native'
+
+        if self._backend == 'astropy':
+            with fits.open(path) as hdus:
+                if ext is None:
+                    for i, hdu in enumerate(hdus):
+                        if getattr(hdu, 'data', None) is not None and \
+                                getattr(hdu, 'columns', None) is not None:
+                            ext = i
+                            break
+                if ext is None:
+                    raise ValueError("no binary table HDU found")
+                self.ext = ext
+                data = hdus[ext].data
+                self.size = len(data)
+                self.dtype = data.dtype
+                self.attrs = dict(hdus[ext].header)
+        else:
+            nat = _NativeFits(path, ext=ext)
+            self._native = nat
+            self.ext = nat.ext
+            self.size = nat.nrows
+            # expose native-endian dtypes to consumers
+            self.dtype = np.dtype([
+                (n, nat.dtype_disk[n].newbyteorder('='))
+                for n in nat.dtype_disk.names])
+            self.attrs = dict(nat.header)
 
     def read(self, columns, start, stop, step=1):
-        from astropy.io import fits
         out = self._empty(columns, len(range(start, stop, step)))
-        with fits.open(self.path) as hdus:
-            data = hdus[self.ext].data[start:stop:step]
-            for col in columns:
-                out[col] = data[col]
+        if self._backend == 'astropy':
+            from astropy.io import fits
+            with fits.open(self.path) as hdus:
+                data = hdus[self.ext].data[start:stop:step]
+                for col in columns:
+                    out[col] = data[col]
+            return out
+        rows = self._native.read_rows(start, stop)[::step]
+        for col in columns:
+            # .base: astype with a subarray dtype would replicate the
+            # trailing axis instead of casting elementwise
+            out[col] = rows[col].astype(self.dtype[col].base)
         return out

@@ -358,3 +358,75 @@ def test_csv_negative_step_and_mid_comments(tmp_path):
                  skiprows=[2])
     np.testing.assert_allclose(f3.read(['a'], 0, f3.size)['a'],
                                [1, 5, 7, 9])
+
+
+def _write_minimal_fits(path, cols):
+    """Hand-roll a standards-conforming single-BINTABLE FITS file
+    (2880-byte header blocks of 80-char cards + big-endian records)."""
+    def card(key, val, quote=False):
+        if quote:
+            v = "'%s'" % val
+        elif isinstance(val, bool):
+            v = 'T' if val else 'F'
+        else:
+            v = str(val)
+        return ('%-8s= %20s' % (key, v)).ljust(80).encode('ascii')
+
+    def block(cards):
+        raw = b''.join(cards) + b'END'.ljust(80, b' ')
+        return raw.ljust(((len(raw) + 2879) // 2880) * 2880, b' ')
+
+    fields = []
+    for name, arr in cols:
+        arr = np.asarray(arr)
+        letter = {'f8': 'D', 'f4': 'E', 'i4': 'J', 'i8': 'K'}[
+            arr.dtype.str[1:]]
+        rep = arr.shape[1] if arr.ndim > 1 else 1
+        fields.append((name, arr, '%d%s' % (rep, letter)))
+    dt = np.dtype([(n, a.dtype.newbyteorder('>'),
+                    (a.shape[1],) if a.ndim > 1 else ())
+                   for n, a, _ in fields])
+    nrows = len(fields[0][1])
+    rec = np.zeros(nrows, dtype=dt)
+    for n, a, _ in fields:
+        rec[n] = a
+
+    with open(path, 'wb') as f:
+        f.write(block([card('SIMPLE', True), card('BITPIX', 8),
+                       card('NAXIS', 0)]))
+        hdr = [card('XTENSION', 'BINTABLE', quote=True),
+               card('BITPIX', 8), card('NAXIS', 2),
+               card('NAXIS1', dt.itemsize), card('NAXIS2', nrows),
+               card('PCOUNT', 0), card('GCOUNT', 1),
+               card('TFIELDS', len(fields))]
+        for i, (n, _, tform) in enumerate(fields):
+            hdr.append(card('TTYPE%d' % (i + 1), n, quote=True))
+            hdr.append(card('TFORM%d' % (i + 1), tform, quote=True))
+        f.write(block(hdr))
+        raw = rec.tobytes()
+        f.write(raw.ljust(((len(raw) + 2879) // 2880) * 2880, b'\0'))
+
+
+def test_fits_native_reader(tmp_path):
+    """The built-in BINTABLE parser reads numeric tables without
+    astropy/fitsio (reference io/fits.py:8 requires the cfitsio
+    binding)."""
+    rng = np.random.RandomState(6)
+    pos = rng.uniform(0, 100, size=(40, 3))
+    mass = rng.uniform(size=40)
+    ids = np.arange(40, dtype='i8')
+    fn = str(tmp_path / 'cat.fits')
+    _write_minimal_fits(fn, [('POS', pos), ('MASS', mass),
+                             ('ID', ids)])
+
+    f = nio.FITSFile(fn)
+    assert f.size == 40
+    assert set(f.dtype.names) == {'POS', 'MASS', 'ID'}
+    out = f.read(['POS', 'ID'], 5, 25)
+    np.testing.assert_allclose(out['POS'], pos[5:25])
+    np.testing.assert_array_equal(out['ID'], ids[5:25])
+
+    from nbodykit_tpu.source.catalog.file import FITSCatalog
+    cat = FITSCatalog(fn)
+    np.testing.assert_allclose(np.asarray(cat['MASS']), mass,
+                               rtol=1e-6)
