@@ -14,7 +14,10 @@ import numpy as np
 from .base import FileType
 
 # TFORMn letter -> numpy big-endian dtype
-_TFORM = {'L': '?', 'B': 'u1', 'I': '>i2', 'J': '>i4', 'K': '>i8',
+# disk representation per TFORM letter; 'L' is the ASCII bytes 'T'/'F'
+# and is exposed as bool after an explicit compare (a raw view would
+# read every 'F' (0x46, nonzero) as True)
+_TFORM = {'L': 'u1', 'B': 'u1', 'I': '>i2', 'J': '>i4', 'K': '>i8',
           'E': '>f4', 'D': '>f8', 'A': 'S'}
 _BLOCK = 2880
 
@@ -36,10 +39,25 @@ def _read_header(ff):
                 break
             if not key or card[8] != '=':
                 continue
-            val = card[10:].split('/')[0].strip()
-            if val.startswith("'"):
-                cards[key] = val.strip("'").strip()
-            elif val in ('T', 'F'):
+            raw = card[10:]
+            if raw.lstrip().startswith("'"):
+                # quoted string: value ends at the first un-doubled
+                # quote; '/' inside is part of the value, '' escapes
+                body = raw.lstrip()[1:]
+                chars, j = [], 0
+                while j < len(body):
+                    if body[j] == "'":
+                        if j + 1 < len(body) and body[j + 1] == "'":
+                            chars.append("'")
+                            j += 2
+                            continue
+                        break
+                    chars.append(body[j])
+                    j += 1
+                cards[key] = ''.join(chars).strip()
+                continue
+            val = raw.split('/')[0].strip()
+            if val in ('T', 'F'):
                 cards[key] = val == 'T'
             else:
                 try:
@@ -71,6 +89,7 @@ class _NativeFits(object):
 
     def __init__(self, path, ext=None):
         self.path = path
+        fsize = self._file_size_of(path)
         with open(path, 'rb') as ff:
             header, off = _read_header(ff)   # primary HDU
             if not header.get('SIMPLE', False):
@@ -78,15 +97,16 @@ class _NativeFits(object):
             hdu_index = 0
             data_size = self._data_bytes(header)
             while True:
-                ff.seek(off + self._padded(data_size))
+                nxt = off + self._padded(data_size)
+                if nxt >= fsize:
+                    raise ValueError("no binary table HDU found")
+                ff.seek(nxt)
                 header, off = _read_header(ff)
                 hdu_index += 1
                 data_size = self._data_bytes(header)
                 if header.get('XTENSION') == 'BINTABLE' and \
                         (ext is None or ext == hdu_index):
                     break
-                if ff.tell() + data_size >= self._file_size():
-                    raise ValueError("no binary table HDU found")
         self.ext = hdu_index
         self.header = header
         self.data_start = off
@@ -94,9 +114,12 @@ class _NativeFits(object):
         self.rowbytes = int(header['NAXIS1'])
 
         fields = []
+        self.logical_cols = set()
         for i in range(1, int(header['TFIELDS']) + 1):
             name = str(header.get('TTYPE%d' % i, 'col%d' % i)).strip()
             repeat, letter = _parse_tform(str(header['TFORM%d' % i]))
+            if letter == 'L':
+                self.logical_cols.add(name)
             if letter == 'A':
                 fields.append((name, 'S%d' % repeat))
             elif repeat == 1:
@@ -110,9 +133,10 @@ class _NativeFits(object):
                 "TFORM layout)" % (self.rowbytes,
                                    self.dtype_disk.itemsize))
 
-    def _file_size(self):
+    @staticmethod
+    def _file_size_of(path):
         import os
-        return os.path.getsize(self.path)
+        return os.path.getsize(path)
 
     @staticmethod
     def _padded(n):
@@ -129,6 +153,10 @@ class _NativeFits(object):
             * int(header.get('GCOUNT', 1)) + int(header.get('PCOUNT', 0))
 
     def read_rows(self, start, stop):
+        if not (0 <= start <= stop <= self.nrows):
+            raise IndexError(
+                "row range [%d, %d) outside table of %d rows"
+                % (start, stop, self.nrows))
         with open(self.path, 'rb') as ff:
             ff.seek(self.data_start + start * self.rowbytes)
             raw = ff.read((stop - start) * self.rowbytes)
@@ -167,10 +195,16 @@ class FITSFile(FileType):
             self._native = nat
             self.ext = nat.ext
             self.size = nat.nrows
-            # expose native-endian dtypes to consumers
+            # expose native-endian dtypes; logical columns read back
+            # as bool
+            def _expose(n):
+                dt = nat.dtype_disk[n].newbyteorder('=')
+                if n in nat.logical_cols:
+                    return np.dtype((np.bool_, dt.shape)) \
+                        if dt.shape else np.dtype(np.bool_)
+                return dt
             self.dtype = np.dtype([
-                (n, nat.dtype_disk[n].newbyteorder('='))
-                for n in nat.dtype_disk.names])
+                (n, _expose(n)) for n in nat.dtype_disk.names])
             self.attrs = dict(nat.header)
 
     def read(self, columns, start, stop, step=1):
@@ -182,9 +216,16 @@ class FITSFile(FileType):
                 for col in columns:
                     out[col] = data[col]
             return out
-        rows = self._native.read_rows(start, stop)[::step]
+        idx = np.arange(start, stop, step)
+        if idx.size == 0:
+            return out
+        lo, hi = int(idx.min()), int(idx.max()) + 1
+        rows = self._native.read_rows(lo, hi)[idx - lo]
         for col in columns:
+            vals = rows[col]
+            if self.dtype[col].base == np.dtype(bool):
+                vals = vals == ord('T')   # FITS 'L' stores 'T'/'F'
             # .base: astype with a subarray dtype would replicate the
             # trailing axis instead of casting elementwise
-            out[col] = rows[col].astype(self.dtype[col].base)
+            out[col] = vals.astype(self.dtype[col].base)
         return out
