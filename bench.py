@@ -9,12 +9,13 @@ the project_to_basis slab loop (SURVEY.md §3.1).
 Prints ONE JSON line on stdout:
     {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
 
-``vs_baseline`` is (estimated reference wallclock) / (ours) — >1 means
-faster than the baseline. The reference publishes no absolute numbers
-(BASELINE.md); we use a 30 s nominal for the dm_like-scale FFTPower on
-a 16-rank MPI node (the reference's example production config,
-nersc/example-job.slurm), documented here so the denominator is stable
-across rounds.
+``vs_baseline`` is (same-config baseline wallclock) / (ours) — >1 means
+faster. The reference publishes no absolute numbers (BASELINE.md) and
+its native stack (pmesh/pfft/mpi4py) is not installable here, so the
+baseline is the SAME pipeline measured on this host's CPU at the SAME
+config (committed per-config in BASELINE_CPU.json, else this run's
+forced-CPU worker). A config with no same-config CPU measurement gets
+no vs_baseline at all — cross-config ratios are not speedups.
 
 Round-3 redesign (rounds 1+2 produced no number — VERDICT.md weak #1):
 the axon TPU tunnel WEDGES when a process with in-flight TPU work is
@@ -72,7 +73,6 @@ WORKER_LOG = os.environ.get(
 # the end-of-round bench can report it even if the tunnel is down then.
 TPU_CACHE_PATH = os.path.join(HERE, 'BENCH_TPU_CACHE.json')
 TOTAL_BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', 1500))
-NOMINAL_BASELINE_S = 30.0  # see module docstring
 
 TPU_PLATFORMS = ('tpu', 'axon')
 
@@ -247,6 +247,53 @@ def _time_fn(jax, fn, args, reps):
     return (time.time() - t0) / reps, compile_s
 
 
+def _baseline_for(metric):
+    """Same-config CPU baseline for ``vs_baseline``, or None.
+
+    vs_baseline is only ever a SAME-CONFIG ratio: the measured CPU
+    wallclock of the identical pipeline/config on this host (the
+    reference implementation itself is not runnable here — its native
+    stack pmesh/pfft/mpi4py is not installed and installs are
+    unavailable — so our pipeline on CPU is the stated stand-in,
+    labeled as such). Sources, in preference order: the committed
+    per-config store BASELINE_CPU.json, then this run's forced-CPU
+    worker detail. A config with no same-config CPU measurement gets NO
+    vs_baseline — a 256-cubed timing divided by a 1024-cubed nominal is
+    not a speedup (round-4 verdict, Weak #1).
+    """
+    for path, src in ((os.path.join(HERE, 'BASELINE_CPU.json'),
+                       'BASELINE_CPU.json'),
+                      (CPU_DETAIL_PATH, 'cpu worker (this run)')):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        recs = data.get('results', {}).values() if 'results' in data \
+            else data.get('configs', [])
+        for rec in recs:
+            if (rec and rec.get('metric') == metric
+                    and rec.get('platform') == 'cpu'
+                    and rec.get('value', -1) > 0):
+                return float(rec['value']), src
+    return None
+
+
+def _attach_baseline(rec):
+    # purge any pre-existing ratio first: cached records from earlier
+    # rounds carry the old cross-config nominal-based vs_baseline,
+    # which must never be republished when no same-config baseline
+    # exists (round-4 verdict, Weak #1)
+    for k in ('vs_baseline', 'baseline_s', 'baseline_source'):
+        rec.pop(k, None)
+    base = _baseline_for(rec.get('metric'))
+    if base is not None and rec.get('value', -1) > 0:
+        rec['vs_baseline'] = round(base[0] / rec['value'], 2)
+        rec['baseline_s'] = base[0]
+        rec['baseline_source'] = 'same-config CPU pipeline, ' + base[1]
+    return rec
+
+
 def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     """One full config measurement; returns a result dict."""
     jax = _setup_jax()
@@ -290,6 +337,10 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
                        ('remote_compile', 'RESOURCE', 'UNAVAILABLE',
                         'INTERNAL')):
                 raise
+            # substring classification can misfire on unrelated errors
+            # whose text happens to contain e.g. 'INTERNAL'; keep the
+            # trigger visible in the record (round-4 advisor)
+            rec['fused_error'] = str(e)[:300]
             staged = True
     if staged:
         rec['mode'] = 'staged'
@@ -310,8 +361,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         for _ in range(reps):
             _sync(jax, s_bin(s_power(s_paint(pos))))
         dt = (time.time() - t0) / reps
-    rec.update(value=round(dt, 4), compile_s=round(compile_s, 1),
-               vs_baseline=round(NOMINAL_BASELINE_S / dt, 2))
+    rec.update(value=round(dt, 4), compile_s=round(compile_s, 1))
+    _attach_baseline(rec)
 
     if phases:
         field_bytes = 4.0 * Nmesh ** 3
@@ -406,6 +457,27 @@ def _cache_tpu_result(rec):
     os.replace(tmp, TPU_CACHE_PATH)
 
 
+def _cache_cpu_baseline(rec):
+    """Merge one CPU config record into the committed same-config
+    baseline store BASELINE_CPU.json (atomic; keyed by metric)."""
+    if rec.get('platform') != 'cpu' or rec.get('value', -1) <= 0:
+        return
+    path = os.path.join(HERE, 'BASELINE_CPU.json')
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {"results": {}}
+    rec = dict(rec)
+    rec['measured_at'] = time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                       time.gmtime())
+    data['results'][rec['metric']] = rec
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(data, f, indent=1)
+    os.replace(tmp, path)
+
+
 def _best_cached_tpu():
     try:
         with open(TPU_CACHE_PATH) as f:
@@ -464,7 +536,7 @@ def cmd_worker():
     # question — TPU scatter serializes on collisions, sort costs
     # O(n log^2 n) bitonic passes)
     results = {}
-    for method in ('scatter', 'sort'):
+    for method in ('scatter', 'sort', 'mxu'):
         try:
             p = run_paint(256, 1_000_000, method=method)
             detail['paint'].append(p)
@@ -490,18 +562,25 @@ def cmd_worker():
         ladder = [(128, 100_000), (256, 1_000_000), (512, 10_000_000),
                   (1024, 10_000_000), (1024, 100_000_000)]
     else:
-        # CPU fallback (wedged tunnel): clearly-marked scale proof.
-        # With the integer-bin histogram rewrite the full Nmesh=1024
-        # pipeline takes ~40 s on one core (docs/PERF.md). The ladder
-        # stops at 1e7 particles: the 1e8 north-star rung adds only
-        # paint time on a platform whose numbers are not comparable
-        # anyway, and TWO workers (this one + the forced-CPU sibling)
-        # may be walking this ladder concurrently on one host.
-        note("NOT on TPU (platform=%s) — CPU scale-proof ladder, "
-             "results will be marked platform=cpu"
+        # CPU fallback (wedged tunnel): clearly-marked scale proof AND
+        # the same-config vs_baseline denominators — so the ladder
+        # matches the TPU rungs exactly (round-4 verdict: a 256-cubed
+        # timing divided by a 1024-cubed nominal is not a speedup).
+        # Smallest-first + per-rung flush; the 1e8 rung may not finish
+        # inside the orchestrator budget, but a long-budget standalone
+        # run commits it to BASELINE_CPU.json for later rounds.
+        note("NOT on TPU (platform=%s) — CPU same-config baseline "
+             "ladder, results will be marked platform=cpu"
              % detail['probe'].get('platform'))
-        ladder = [(128, 100_000), (256, 1_000_000), (512, 1_000_000),
+        ladder = [(128, 100_000), (256, 1_000_000), (512, 10_000_000),
                   (1024, 10_000_000)]
+        if os.environ.get('BENCH_CPU_FULL'):
+            # the 1e8 north-star rung takes tens of minutes on this
+            # 1-core host and TWO workers (a fallen-back TPU worker +
+            # the forced-CPU sibling) can be walking this ladder
+            # concurrently — multi-GB fields each. Only a dedicated
+            # long-budget baseline run (BENCH_CPU_FULL=1) attempts it.
+            ladder.append((1024, 100_000_000))
     for Nmesh, Npart in ladder:
         detail['state'] = 'config_nmesh%d_npart%.0e' % (Nmesh, Npart)
         _flush_detail(detail)
@@ -509,6 +588,7 @@ def cmd_worker():
             res = run_config(Nmesh, Npart, method=best_method)
             detail['configs'].append(res)
             _cache_tpu_result(res)
+            _cache_cpu_baseline(res)
             note("ok: %s" % res)
         except Exception as e:
             detail['configs'].append({
@@ -626,9 +706,11 @@ def main():
     # earlier in the round > live CPU fallback (clearly marked) > -1
     best = _best_from_detail(state, tpu_only=True)
     if best is not None:
-        out = {k: best[k] for k in ("metric", "value", "unit",
-                                    "vs_baseline")}
+        out = {k: best.get(k) for k in ("metric", "value", "unit",
+                                        "vs_baseline")}
         out['platform'] = best.get('platform')
+        if best.get('baseline_source'):
+            out['baseline_source'] = best['baseline_source']
         if not state.get('done'):
             out['note'] = ('budget elapsed at state=%s; worker left '
                            'running, larger configs may still land in '
@@ -639,9 +721,12 @@ def main():
 
     cached = _best_cached_tpu()
     if cached is not None:
+        _attach_baseline(cached)
         out = {k: cached.get(k) for k in ("metric", "value", "unit",
                                           "vs_baseline")}
         out['platform'] = cached.get('platform')
+        if cached.get('baseline_source'):
+            out['baseline_source'] = cached['baseline_source']
         out['note'] = ('live TPU run unavailable this invocation '
                        '(worker state: %s); reporting the most recent '
                        'real-TPU measurement, taken at %s UTC '
@@ -655,8 +740,8 @@ def main():
 
     best = _best_from_detail(state)
     if best is not None:
-        out = {k: best[k] for k in ("metric", "value", "unit",
-                                    "vs_baseline")}
+        out = {k: best.get(k) for k in ("metric", "value", "unit",
+                                        "vs_baseline")}
         out['platform'] = best.get('platform')
         out['note'] = ('CPU FALLBACK — the axon tunnel was wedged, so '
                        'this is NOT a TPU number; do not compare '

@@ -40,9 +40,12 @@ _default_options = {
     'exchange_slack': 1.25,
     # default resampler window
     'resampler': 'cic',
-    # paint kernel: 'scatter' (chunked scatter-add) or 'sort'
-    # (scatter-free sort + segmented reduction; see ops/paint.py)
+    # paint kernel: 'scatter' (chunked scatter-add), 'sort'
+    # (scatter-free sort + segmented reduction) or 'mxu'
+    # (tile-bucketed batched-matmul deposit; see ops/paint.py)
     'paint_method': 'scatter',
+    # bucket-capacity slack for the 'mxu' paint kernel
+    'paint_bucket_slack': 2.0,
 }
 
 
@@ -118,7 +121,9 @@ class set_options(object):
     resampler : str
         default window: 'nnb', 'cic', 'tsc', 'pcs'.
     paint_method : str
-        'scatter' or 'sort' — the local deposit kernel.
+        'scatter', 'sort' or 'mxu' — the local deposit kernel.
+    paint_bucket_slack : float
+        bucket-capacity slack factor for the 'mxu' paint kernel.
     """
 
     def __init__(self, **kwargs):

@@ -4,7 +4,8 @@ extension kernels (pmesh C paint, kdcount, Corrfunc; SURVEY.md §2.3)."""
 
 from .window import (RESAMPLERS, window_support, window_weights,
                      compensation_transfer)
-from .paint import paint_local, readout_local
+from .paint import paint_local, paint_local_mxu, readout_local
 
 __all__ = ['RESAMPLERS', 'window_support', 'window_weights',
-           'compensation_transfer', 'paint_local', 'readout_local']
+           'compensation_transfer', 'paint_local', 'paint_local_mxu',
+           'readout_local']

@@ -35,7 +35,8 @@ from .parallel import dfft
 from .parallel.halo import halo_add, halo_fill
 from .parallel.exchange import exchange_by_dest
 from .ops.window import window_support
-from .ops.paint import paint_local, paint_local_sorted, readout_local
+from .ops.paint import (paint_local, paint_local_sorted, paint_local_mxu,
+                        readout_local)
 
 
 def _triplet(x, dtype):
@@ -249,52 +250,95 @@ class ParticleMesh(object):
             jnp.asarray(mass, self.dtype), (npart,))
         chunk = _global_options['paint_chunk_size']
 
-        kernel = paint_local_sorted if \
-            _global_options['paint_method'] == 'sort' else \
-            (lambda *a, **kw: paint_local(*a, chunk=chunk, **kw))
+        pm_method = _global_options['paint_method']
+        traced = isinstance(cpos, jax.core.Tracer)
+
+        def make_kernel(mxu_slack):
+            """All kernels return (block, overflow); only mxu can
+            actually overflow (bucket capacity)."""
+            if pm_method == 'sort':
+                def kern(*a, **kw):
+                    return (paint_local_sorted(*a, **kw),
+                            jnp.zeros((), jnp.int32))
+            elif pm_method == 'mxu':
+                def kern(*a, **kw):
+                    return paint_local_mxu(*a, slack=mxu_slack,
+                                           return_overflow=True, **kw)
+            else:
+                def kern(*a, **kw):
+                    return (paint_local(*a, chunk=chunk, **kw),
+                            jnp.zeros((), jnp.int32))
+            return kern
+
+        mxu_slack = _global_options['paint_bucket_slack']
         if self.nproc == 1:
-            block = kernel(cpos, massa, self.shape_real,
-                           resampler=resampler, period=self.shape_real,
-                           origin=0)
+            block, over = make_kernel(mxu_slack)(
+                cpos, massa, self.shape_real, resampler=resampler,
+                period=self.shape_real, origin=0)
+            # eager mxu bucket-overflow backoff, mirroring the exchange
+            # retry contract (traced callers see the count via
+            # return_dropped)
+            while not traced and int(over) > 0 and mxu_slack < 1e6:
+                mxu_slack *= 4
+                self.logger.info(
+                    "mxu paint bucket overflow (%d dropped); retrying "
+                    "with slack=%g" % (int(over), mxu_slack))
+                block, over = make_kernel(mxu_slack)(
+                    cpos, massa, self.shape_real, resampler=resampler,
+                    period=self.shape_real, origin=0)
             out = block if out is None else out + block
             if return_dropped:
-                return out, jnp.zeros((), jnp.int32)
+                return out, over
             return out
 
         n0 = self._check_halo(h)
         # route particles (in cell units) to their slab owner
         cell = jnp.mod(jnp.floor(cpos[:, 0]).astype(jnp.int32), N0)
         dest = cell // n0
-        traced = isinstance(cpos, jax.core.Tracer)
         self._check_overflow_contract(capacity, traced, return_dropped)
         nproc = self.nproc
 
-        def local(cpos_l, mass_l):
-            d = jax.lax.axis_index(AXIS)
-            origin = d * n0 - h
-            ext = kernel(cpos_l, mass_l, (n0 + 2 * h, N1, N2),
-                         resampler=resampler, period=(N0, N1, N2),
-                         origin=origin)
-            return halo_add(ext, h, nproc)
+        def make_local(kernel):
+            def local(cpos_l, mass_l):
+                d = jax.lax.axis_index(AXIS)
+                origin = d * n0 - h
+                ext, over = kernel(cpos_l, mass_l,
+                                   (n0 + 2 * h, N1, N2),
+                                   resampler=resampler,
+                                   period=(N0, N1, N2), origin=origin)
+                return halo_add(ext, h, nproc), jax.lax.psum(over, AXIS)
+            return local
 
-        def attempt(cap):
+        def attempt(cap, slack_val=None):
+            kernel = make_kernel(slack_val if slack_val is not None
+                                 else mxu_slack)
             recv, valid, dropped = exchange_by_dest(
                 dest, [cpos, massa], self.comm, cap)
             cpos_r, mass_r = recv
             mass_r = jnp.where(valid, mass_r, 0.0).astype(self.dtype)
-            block = jax.shard_map(
-                local, mesh=self.comm,
+            block, over = jax.shard_map(
+                make_local(kernel), mesh=self.comm,
                 in_specs=(P(AXIS, None), P(AXIS)),
-                out_specs=P(AXIS, None, None))(cpos_r, mass_r)
-            return block, dropped
+                out_specs=(P(AXIS, None, None), P()))(cpos_r, mass_r)
+            return block, dropped, over
 
-        block, dropped = attempt(capacity)
-        if not traced and capacity is not None:
-            block, dropped, capacity = self._retry_grown(
-                attempt, block, dropped, capacity, npart)
+        block, dropped, over = attempt(capacity)
+        if not traced and capacity is not None and int(dropped) > 0:
+            _, _, capacity = self._retry_grown(
+                lambda cap: attempt(cap)[:2], block, dropped, capacity,
+                npart)
+            # refresh all three outputs at the grown capacity (the
+            # larger per-device receive set can also change overflow)
+            block, dropped, over = attempt(capacity)
+        while not traced and int(over) > 0 and mxu_slack < 1e6:
+            mxu_slack *= 4
+            self.logger.info(
+                "mxu paint bucket overflow (%d dropped); retrying "
+                "with slack=%g" % (int(over), mxu_slack))
+            block, dropped, over = attempt(capacity, mxu_slack)
         out = block if out is None else out + block
         if return_dropped:
-            return out, dropped
+            return out, dropped + over
         return out
 
     def _check_overflow_contract(self, capacity, traced, return_dropped):
