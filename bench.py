@@ -211,18 +211,27 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
         p3 = (jnp.abs(c) ** 2).astype(jnp.float32) * V
         return p3.at[0, 0, 0].set(0.0)
 
+    def paint(pos):
+        # return_dropped satisfies the traced-mxu overflow contract;
+        # run_config checks the count once per config via
+        # 'paint_dropped' (uniform bench data cannot overflow the
+        # default slack, but the check keeps the number honest)
+        field, _ = pm.paint(pos, 1.0, resampler=resampler,
+                            return_dropped=True)
+        return field
+
     def power3d(pos):
         n = pos.shape[0]
-        return field_power(
-            pm.paint(pos, 1.0, resampler=resampler) / (n / pm.Ntot))
+        return field_power(paint(pos) / (n / pm.Ntot))
 
     def fftpower(pos):
         return binning(power3d(pos))
 
     phases = {
-        'paint': lambda pos: pm.paint(pos, 1.0, resampler=resampler),
-        'paint_fft': lambda pos: pm.r2c(
-            pm.paint(pos, 1.0, resampler=resampler)),
+        'paint': paint,
+        'paint_dropped': lambda pos: pm.paint(
+            pos, 1.0, resampler=resampler, return_dropped=True)[1],
+        'paint_fft': lambda pos: pm.r2c(paint(pos)),
         'power3d': power3d,
         # staged-pipeline pieces: at Nmesh>=512 the axon remote-compile
         # helper dies (HTTP 500) on the single fused program, while the
@@ -364,6 +373,12 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     rec.update(value=round(dt, 4), compile_s=round(compile_s, 1))
     _attach_baseline(rec)
 
+    if method == 'mxu':
+        rec['paint_dropped'] = int(
+            jax.jit(phase_fns['paint_dropped'])(pos))
+        if rec['paint_dropped']:
+            rec['error'] = ('mxu bucket overflow dropped %d particles '
+                            'at default slack' % rec['paint_dropped'])
     if phases:
         field_bytes = 4.0 * Nmesh ** 3
         t_paint, _ = _time_fn(jax, jax.jit(phase_fns['paint']),
@@ -416,7 +431,8 @@ def run_paint(Nmesh, Npart, method='scatter', reps=3):
     nbodykit_tpu.set_options(paint_method=method)
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     pos = _make_pos(jax, jnp, Npart, 1000.0)
-    fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic'))
+    fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic',
+                                    return_dropped=True)[0])
     dt, _ = _time_fn(jax, fn, (pos,), reps)
     return {
         "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"

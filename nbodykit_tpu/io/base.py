@@ -36,11 +36,32 @@ class FileType(object):
         return self.size
 
     def __getitem__(self, sel):
+        """Selection semantics mirroring the reference FileType
+        (nbodykit/io/base.py getitem): a column name reads that column;
+        a list of names returns a restricted view (IndexError on empty
+        or unknown names — and a single-column view cannot be
+        column-sliced again); a slice reads rows; a boolean mask or
+        integer list reads the matching rows of all columns."""
         if isinstance(sel, str):
-            return self.read([sel], 0, self.size)[sel]
+            if sel not in self.columns:
+                raise IndexError("no such column: %r" % sel)
+            return _ColumnSubset(self, [sel])
+        if isinstance(sel, list) and all(isinstance(s, str)
+                                         for s in sel):
+            if not sel:
+                raise IndexError("empty column selection")
+            bad = [s for s in sel if s not in self.columns]
+            if bad:
+                raise IndexError("no such columns: %s" % bad)
+            return _ColumnSubset(self, sel)
         if isinstance(sel, slice):
             start, stop, step = sel.indices(self.size)
             return self.read(self.columns, start, stop, step)
+        sel = np.asarray(sel)
+        if sel.dtype == bool or np.issubdtype(sel.dtype, np.integer):
+            if sel.ndim != 1:
+                raise IndexError("row selections must be 1-D")
+            return self.read(self.columns, 0, self.size)[sel]
         raise KeyError(sel)
 
     def keys(self):
@@ -51,8 +72,51 @@ class FileType(object):
         return np.empty(n, dtype=dt)
 
     def asarray(self):
-        return self
+        """All columns stacked into one unstructured (size, ncol*...)
+        array (reference: FileType.asarray via dask.stack; eager
+        here). Columns must share a base dtype."""
+        base = {self.dtype[c].base for c in self.columns}
+        if len(base) > 1:
+            raise ValueError("asarray() requires a uniform column "
+                             "dtype, have %s" % sorted(map(str, base)))
+        data = self.read(self.columns, 0, self.size)
+        cols = []
+        for c in self.columns:
+            a = data[c]
+            cols.append(a.reshape(len(a), -1))
+        return np.concatenate(cols, axis=1)
 
     def __repr__(self):
         return "%s(size=%d, ncol=%d)" % (self.__class__.__name__,
                                          self.size or 0, self.ncol)
+
+
+class _ColumnSubset(FileType):
+    """A column-restricted view of another FileType (what ``f[['a',
+    'b']]`` returns); reads delegate to the parent."""
+
+    def __init__(self, parent, columns):
+        self._parent = parent
+        self.dtype = np.dtype([(c, parent.dtype[c]) for c in columns])
+        self.size = parent.size
+
+    def read(self, columns, start, stop, step=1):
+        bad = [c for c in columns if c not in self.dtype.names]
+        if bad:
+            raise IndexError("no such columns: %s" % bad)
+        return self._parent.read(columns, start, stop, step)
+
+    def __getitem__(self, sel):
+        if (isinstance(sel, str) or isinstance(sel, list)) \
+                and len(self.dtype.names) == 1:
+            # reference contract: a single-column view is terminal
+            raise IndexError(
+                "cannot column-slice a single-column view")
+        if isinstance(sel, slice):
+            start, stop, step = sel.indices(self.size)
+            # a one-column slice reads as a plain (unstructured) array
+            if len(self.dtype.names) == 1:
+                name = self.dtype.names[0]
+                return self.read([name], start, stop, step)[name]
+            return self.read(list(self.dtype.names), start, stop, step)
+        return super(_ColumnSubset, self).__getitem__(sel)

@@ -146,11 +146,20 @@ class _NativeFits(object):
     def _data_bytes(header):
         if header.get('NAXIS', 0) == 0:
             return 0
+        naxes = [int(header.get('NAXIS%d' % i, 0))
+                 for i in range(1, int(header['NAXIS']) + 1)]
+        # random-groups convention: NAXIS1 == 0 means "no primary
+        # array"; the group size is the product of the REMAINING axes
+        if naxes and naxes[0] == 0 and len(naxes) > 1:
+            naxes = naxes[1:]
         n = 1
-        for i in range(1, int(header['NAXIS']) + 1):
-            n *= int(header.get('NAXIS%d' % i, 0))
-        return n * abs(int(header.get('BITPIX', 8))) // 8 \
-            * int(header.get('GCOUNT', 1)) + int(header.get('PCOUNT', 0))
+        for a in naxes:
+            n *= a
+        # FITS standard sizing: |BITPIX|/8 * GCOUNT * (PCOUNT + prod(NAXIS))
+        # — PCOUNT bytes scale with BITPIX/GCOUNT too (random-groups HDUs)
+        return abs(int(header.get('BITPIX', 8))) // 8 \
+            * int(header.get('GCOUNT', 1)) \
+            * (int(header.get('PCOUNT', 0)) + n)
 
     def read_rows(self, start, stop):
         if not (0 <= start <= stop <= self.nrows):

@@ -244,12 +244,20 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
                 # (XLA cannot elide masked updates) but only the
                 # O(n*s^3/N) boundary entries carry weight; masked
                 # slots get DISTINCT out-of-bounds indices so they do
-                # not pile up on one colliding index. (If sent+j*n+idx
-                # wraps int32 at extreme M*s^3*n, a masked slot may
-                # alias an in-bounds cell — harmless: its value is 0.)
+                # not pile up on one colliding index — EXCEPT when
+                # sent + s^3*n + n would wrap int32 (a masked slot
+                # could then alias an in-bounds cell; its zero value
+                # makes that silent, not safe): there all masked slots
+                # share the single provably-OOB index `sent` instead.
+                # Dropped updates never read-modify-write memory, so
+                # the shared index costs nothing.
                 fb = unwrapped | ~valida
                 j = len(offs) - 1
-                fb_keys.append(jnp.where(fb, sent + j * n + idx, lin))
+                if sent + (s ** 3) * n + n < 2 ** 31 - 1:
+                    fkey = sent + j * n + idx
+                else:
+                    fkey = sent
+                fb_keys.append(jnp.where(fb, fkey, lin))
                 fb_vals.append(jnp.where(fb, 0, w))
 
     if fb_keys:

@@ -9,12 +9,14 @@ on ``jax.sharding.Mesh`` + ``jax.shard_map`` + XLA collectives.
 from .runtime import CurrentMesh, use_mesh, cpu_mesh, tpu_mesh, single_device_mesh
 from .dfft import dist_rfftn, dist_irfftn, dist_fft_plan
 from .halo import halo_add, halo_fill
-from .exchange import exchange_by_dest, auto_capacity
+from .exchange import (exchange_by_dest, auto_capacity,
+                       counted_capacity)
 from .sort import dist_sort
 
 __all__ = [
     'CurrentMesh', 'use_mesh', 'cpu_mesh', 'tpu_mesh', 'single_device_mesh',
     'dist_rfftn', 'dist_irfftn', 'dist_fft_plan',
     'halo_add', 'halo_fill',
-    'exchange_by_dest', 'auto_capacity', 'dist_sort',
+    'exchange_by_dest', 'auto_capacity', 'counted_capacity',
+    'dist_sort',
 ]

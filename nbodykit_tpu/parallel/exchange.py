@@ -21,6 +21,14 @@ trace, callers must pass an explicit capacity; the ``dropped`` count is
 returned so they can detect overflow outside jit and retry larger — the
 same contract as the reference's paint-chunk backoff loop
 (source/mesh/catalog.py:275-315).
+
+For LARGE traced pipelines use the two-pass counted exchange: run
+:func:`counted_capacity` eagerly (pass 1 — a tiny count program), then
+hand its result to the traced exchange as the static capacity (pass 2)
+with ``return_dropped=True``. The traced fallback bound ceil(N/P) is
+always sufficient but allocates N payload slots per device — at
+N=1e9 that is ~16 GB and cannot sit next to a 2048^3 mesh
+(pmesh.memory_plan models both).
 """
 
 import numpy as np
@@ -29,6 +37,53 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .runtime import AXIS, mesh_size
+
+
+def counted_capacity(pm_or_nproc, pos_or_dest, slack=1.05, n0=None):
+    """Two-pass counted exchange, pass 1: the exact per-(src,dst)
+    particle count, run EAGERLY so pass 2 (the traced exchange inside
+    the main jit) can size its all_to_all buffers statically.
+
+    The always-sufficient traced default is capacity = ceil(N/P): every
+    source may ship its whole shard to one destination. That bound
+    makes the send buffer per device N slots — ~16 GB of payload at
+    N=1e9 — which cannot sit next to a 2048^3 mesh in HBM. The counted
+    bound is ~N/P^2 * imbalance instead (~1000x smaller at P=16), the
+    same reason the reference's MPI all-to-allv counts first
+    (pmesh.domain.GridND.decompose; consumed at
+    nbodykit/source/mesh/catalog.py:271-284).
+
+    Parameters
+    ----------
+    pm_or_nproc : a ParticleMesh-like (with .nproc — routing is then
+        delegated to ``pm.exchange_capacity``, which reuses paint's own
+        dest computation including the interlacing ``shift``) or an int
+        device count (then ``pos_or_dest`` must be dest indices or raw
+        x positions in CELL units with ``n0`` given)
+    pos_or_dest : (N, 3) positions, or (N,) int32 dest
+    slack : headroom on the counted max (particles may move between
+        the count and the exchange only within this margin)
+    n0 : slab height in cells (required with positions + int nproc)
+
+    Returns a Python int, usable as the static ``capacity`` of
+    :func:`exchange_by_dest` / ``ParticleMesh.paint`` inside jit
+    (combine with ``return_dropped=True`` to detect any drift past the
+    slack after the step).
+    """
+    if hasattr(pm_or_nproc, 'nproc'):
+        return pm_or_nproc.exchange_capacity(pos_or_dest, slack=slack)
+    nproc = int(pm_or_nproc)
+    if pos_or_dest.ndim == 2:
+        if n0 is None:
+            raise ValueError("pass n0 (slab height) with raw "
+                             "positions and an int device count")
+        dest = jnp.floor(jnp.asarray(pos_or_dest)[:, 0]).astype(
+            jnp.int32) // n0
+    else:
+        dest = jnp.asarray(pos_or_dest, jnp.int32)
+    if nproc == 1:
+        return int(dest.shape[0])
+    return auto_capacity(dest, nproc, slack=slack)
 
 
 def auto_capacity(dest, nproc, slack=1.05):

@@ -217,6 +217,36 @@ class ParticleMesh(object):
                 % (h, n0, int(self.Nmesh[0]), self.nproc))
         return n0
 
+    def _route_dest(self, cpos):
+        """Slab owner per particle (cpos in cell units, shift already
+        applied) — THE routing rule, shared by paint/readout and the
+        counted-capacity pass so they cannot drift apart."""
+        N0 = int(self.Nmesh[0])
+        n0 = N0 // self.nproc
+        cell = jnp.mod(jnp.floor(cpos[:, 0]).astype(jnp.int32), N0)
+        return cell // n0
+
+    def exchange_capacity(self, pos, slack=1.05, shift=0.0):
+        """Two-pass counted exchange, pass 1 (run EAGERLY): the exact
+        per-(src,dst) routing count for these positions, with slack.
+
+        Pass the result as ``capacity=`` to a *traced* :meth:`paint` /
+        :meth:`readout` (with ``return_dropped=True``) so the
+        all_to_all buffers are counted-size (~N/P^2) instead of the
+        always-sufficient ceil(N/P) — the difference between fitting
+        a 2048^3 mesh next to a 1e9-particle exchange and OOM (see
+        :func:`memory_plan` and parallel/exchange.py).
+
+        ``shift`` must match the paint's (interlaced painting routes by
+        the half-cell-shifted grid; take the max of the capacities at
+        shift 0 and 0.5 for an interlaced pair of paints).
+        """
+        from .parallel.exchange import auto_capacity
+        if self.nproc == 1:
+            return int(pos.shape[0])
+        dest = self._route_dest(self._to_cell_units(pos) - shift)
+        return auto_capacity(dest, self.nproc, slack=slack)
+
     def paint(self, pos, mass=1.0, resampler=None, out=None, shift=0.0,
               capacity=None, return_dropped=False):
         """Scatter particles onto the mesh; returns a real field.
@@ -252,6 +282,18 @@ class ParticleMesh(object):
 
         pm_method = _global_options['paint_method']
         traced = isinstance(cpos, jax.core.Tracer)
+        if traced and pm_method == 'mxu' and not return_dropped:
+            # same contract as an explicit exchange capacity: the mxu
+            # bucket capacity is slack-sized, not provably sufficient,
+            # and under a trace the eager backoff cannot run — silent
+            # particle loss must be impossible, so the caller has to
+            # receive (and check) the dropped count
+            raise ValueError(
+                "paint_method='mxu' inside jit requires "
+                "return_dropped=True: bucket overflow cannot retry "
+                "under a trace, so the dropped count must be checked "
+                "after the step (or paint eagerly / use "
+                "paint_method='scatter')")
 
         def make_kernel(mxu_slack):
             """All kernels return (block, overflow); only mxu can
@@ -292,9 +334,7 @@ class ParticleMesh(object):
             return out
 
         n0 = self._check_halo(h)
-        # route particles (in cell units) to their slab owner
-        cell = jnp.mod(jnp.floor(cpos[:, 0]).astype(jnp.int32), N0)
-        dest = cell // n0
+        dest = self._route_dest(cpos)
         self._check_overflow_contract(capacity, traced, return_dropped)
         nproc = self.nproc
 
@@ -324,12 +364,21 @@ class ParticleMesh(object):
 
         block, dropped, over = attempt(capacity)
         if not traced and capacity is not None and int(dropped) > 0:
-            _, _, capacity = self._retry_grown(
-                lambda cap: attempt(cap)[:2], block, dropped, capacity,
-                npart)
-            # refresh all three outputs at the grown capacity (the
-            # larger per-device receive set can also change overflow)
-            block, dropped, over = attempt(capacity)
+            # eager exchange-capacity backoff (reference:
+            # source/mesh/catalog.py:275-315), keeping all three
+            # outputs from the final attempt
+            cap_max = -(-npart // self.nproc) + 8
+            while int(dropped) > 0 and capacity < cap_max:
+                capacity = min(2 * capacity, cap_max)
+                self.logger.info(
+                    "exchange overflow (%d dropped); retrying with "
+                    "capacity=%d" % (int(dropped), capacity))
+                block, dropped, over = attempt(capacity)
+            if int(dropped) > 0:
+                raise RuntimeError(
+                    "particle exchange still overflowing at the "
+                    "maximal capacity %d — this should be impossible"
+                    % capacity)
         while not traced and int(over) > 0 and mxu_slack < 1e6:
             mxu_slack *= 4
             self.logger.info(
@@ -479,7 +528,8 @@ class ParticleMesh(object):
 
 def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
                 paint_method='scatter', paint_chunk=None,
-                hbm_bytes=16e9):
+                hbm_bytes=16e9, exchange='counted',
+                exchange_imbalance=1.5):
     """Estimated peak per-device HBM for the FFTPower pipeline
     (paint -> rFFT -> |delta_k|^2 -> chunked binning) — the arithmetic
     behind chunk-size choices and the BASELINE.md scale claims
@@ -490,6 +540,14 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     allocator margin). Estimates, not guarantees — XLA's actual
     buffers vary; the model errs high on the FFT workspace (2x the
     complex field for the out-of-place transposed passes).
+
+    ``exchange`` models the multi-device particle routing buffers:
+    'counted' assumes the two-pass counted capacity (eager
+    :func:`~nbodykit_tpu.parallel.exchange.counted_capacity` feeding a
+    static ~npart/P^2 * ``exchange_imbalance`` per-pair buffer —
+    pass 1 of the two-pass exchange); 'ceil' is the traced fallback
+    bound ceil(N/P) per pair (npart payload slots per device — the
+    safe-but-fat bound that cannot sit next to a 2048^3 mesh).
     """
     N = _triplet(Nmesh, 'i8')
     ndev = max(int(ndevices), 1)
@@ -510,21 +568,52 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
         # all s^3 deposit terms live at once: (key i32 + val) pairs,
         # doubled by the sort's out-of-place buffers
         paint_tmp = (s ** 3) * (4 + item) * (npart / ndev) * 2
+    elif paint_method == 'mxu':
+        # padded bucket payload (slack * (pos + mass)), the argsort of
+        # the n keys (key + order i32, out-of-place), one x-stripe's
+        # W0Y/Z one-hot expansions (transient inside the scan), and the
+        # halo-padded mesh rows
+        slack = _global_options['paint_bucket_slack']
+        nl = npart / ndev
+        rb = cb = 8
+        rbh, cbh = rb + s - 1, cb + s - 1
+        n0l = max(int(N[0]) // ndev, 1)
+        ntx = max(-(-n0l // rb), 1)
+        stripe = slack * nl / ntx * (rbh * cbh + int(N[2])) * item
+        paint_tmp = (slack * nl * 4 * item     # padded pos+mass
+                     + nl * 8 * 2              # sort keys + order
+                     + stripe
+                     + (rb + s) * int(N[1]) * int(N[2]) * item)
     else:
         paint_tmp = (s ** 3) * (4 + item) * live
     p3 = cplx / 2               # |delta_k|^2 as real of the half-spec
+    # multi-device particle routing: send + recv all_to_all buffers,
+    # (P, capacity) payload slots each (pos 3*item + mass item + live
+    # byte + dest i4). capacity per (src,dst) pair:
+    #   counted: ~npart/P^2 * imbalance (two-pass counted exchange)
+    #   ceil:    ceil(npart/P)          (traced always-sufficient)
+    if ndev > 1:
+        payload = 3 * item + item + 1 + 4
+        if exchange == 'ceil':
+            cap = -(-npart // ndev)
+        else:
+            cap = npart / (ndev * ndev) * exchange_imbalance
+        exch = 2 * ndev * cap * payload
+    else:
+        exch = 0.0
     phases = {
         'real_field': real,
         'complex_field': cplx,
         'fft_workspace': fft_ws,
         'positions': pos_b,
         'paint_temporaries': paint_tmp,
+        'exchange_buffers': exch,
         'power3d': p3,
     }
-    # paint phase: field + positions + temporaries;
+    # paint phase: field + positions + temporaries + exchange;
     # fft phase: real + complex + workspace (positions still resident
     # unless donated); binning adds only O(chunk) slabs
-    peak = max(real + pos_b + paint_tmp,
+    peak = max(real + pos_b + paint_tmp + exch,
                real + cplx + fft_ws + pos_b,
                cplx + p3 + pos_b)
     phases['peak_bytes'] = peak

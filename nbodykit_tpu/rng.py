@@ -68,8 +68,8 @@ class DistributedRNG(object):
         if lam.ndim > 0:
             shape = jnp.broadcast_shapes(shape, lam.shape)
         p = jax.random.poisson(self._next_key(), lam, shape=shape)
-        dt = jnp.zeros(0, jnp.dtype(dtype)).dtype  # canonical (x64-off
-        return self._place(p.astype(dt))           # -> i4, silent)
+        from .utils import working_dtype                 # i8 -> i4
+        return self._place(p.astype(working_dtype(dtype)))  # if x64 off
 
     def choice(self, choices, p=None, itemshape=None):
         choices = jnp.asarray(choices)

@@ -13,16 +13,19 @@ import jax
 
 
 def working_dtype(dt='f8'):
-    """The widest available real dtype no wider than ``dt``: float64
-    when x64 is enabled, else float32 — *without* the per-callsite
-    "requested dtype float64 ... truncated" warning that a direct
-    ``jnp.asarray(x, jnp.float64)`` emits on TPU (no f64 hardware).
-    Use for 'compute in the best precision we have' sites."""
+    """The widest available dtype no wider than ``dt``: the 64-bit
+    float/complex/int types when x64 is enabled, else their 32-bit
+    counterparts — *without* the per-callsite "requested dtype float64
+    ... truncated" warning that a direct ``jnp.asarray(x, jnp.float64)``
+    emits on TPU (no f64 hardware). Use for 'compute in the best
+    precision we have' sites."""
     import jax
-    if np.dtype(dt).kind == 'f' and np.dtype(dt).itemsize == 8 \
-            and not jax.config.jax_enable_x64:
-        return np.dtype('f4')
-    return np.dtype(dt)
+    dt = np.dtype(dt)
+    if dt.itemsize == 8 * (2 if dt.kind == 'c' else 1) \
+            and dt.kind in 'fciu' and not jax.config.jax_enable_x64:
+        return np.dtype({'f': 'f4', 'c': 'c8', 'i': 'i4',
+                         'u': 'u4'}[dt.kind])
+    return dt
 
 
 def as_numpy(arr):
