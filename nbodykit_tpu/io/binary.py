@@ -31,8 +31,25 @@ class BinaryFile(FileType):
         self.dtype = np.dtype(dtype)
         fsize = os.path.getsize(path)
 
+        if offsets is not None and not isinstance(offsets, dict):
+            raise TypeError("offsets must be a dict of column -> byte "
+                            "offset, got %s" % type(offsets).__name__)
+        if offsets is not None:
+            missing = [n for n in self.dtype.names if n not in offsets]
+            if missing:
+                raise ValueError("offsets missing columns: %s" % missing)
+
         if size is None:
-            size = (fsize - header_size) // self.dtype.itemsize
+            payload = fsize - header_size
+            # the exact-multiple check encodes the back-to-back-after-
+            # header layout, which only holds without custom offsets
+            if offsets is None and (payload < 0
+                                    or payload % self.dtype.itemsize):
+                raise ValueError(
+                    "cannot infer size: file has %d payload bytes, not "
+                    "a multiple of the %d-byte row (wrong header_size "
+                    "or dtype?)" % (payload, self.dtype.itemsize))
+            size = max(payload, 0) // self.dtype.itemsize
         self.size = int(size)
 
         if offsets is None:

@@ -40,6 +40,14 @@ class CSVFile(FileType):
             dt = [(n, dtype) for n in self._names]
         self.dtype = np.dtype(dt)
         self._config = dict(config)
+        # the partitioned-read contract cannot honor these pandas
+        # keywords (reference nbodykit/io/csv.py raises on its own
+        # forbidden set: names would shift, rows would double-count)
+        for bad_kw in ('index_col', 'header', 'skipfooter'):
+            if bad_kw in self._config:
+                raise ValueError(
+                    "keyword %r is not supported by the partitioned "
+                    "CSV reader" % bad_kw)
         # skiprows/nrows are partitioning-reserved in read(); user
         # values restrict the file's logical extent instead. An int
         # skiprows drops leading physical lines (pandas semantics); a
@@ -49,7 +57,6 @@ class CSVFile(FileType):
         self._config.setdefault('comment', '#')
         if delim_whitespace:
             self._config.setdefault('sep', r'\s+')
-        self._pd = pd
 
         # one scan recording only the NON-data line offsets (comments,
         # blanks, user-skipped): logical->physical row mapping is then
@@ -62,6 +69,7 @@ class CSVFile(FileType):
         skip_n = int(user_skip) if np.isscalar(user_skip) else 0
         bad = []
         total = 0
+        first_line = None
         with open(path, 'rb') as ff:
             for i, line in enumerate(ff):
                 total += 1
@@ -70,8 +78,27 @@ class CSVFile(FileType):
                         or (comment_b is not None
                             and line.lstrip().startswith(comment_b))):
                     bad.append(i)
+                elif first_line is None:
+                    first_line = line
         self._bad_lines = np.asarray(bad, dtype='i8')
         self.size = total - len(bad)
+        # the name list must cover the file's columns exactly
+        # (reference: pandas raises through CSVFile on a mismatch).
+        # Parse the first data line with pandas ITSELF — the same
+        # sep/comment/quoting rules read() uses — so the count cannot
+        # diverge from the real parser (a hand tokenizer mishandles
+        # inline comments, literal-vs-regex seps, empty fields)
+        if self.size > 0 and first_line is not None:
+            import io as _io
+            cfg1 = {k: v for k, v in self._config.items()
+                    if k != 'skiprows'}
+            df1 = pd.read_csv(_io.BytesIO(first_line), header=None,
+                              nrows=1, **cfg1)
+            nf = df1.shape[1]
+            if nf != len(self._all_names):
+                raise ValueError(
+                    "file has %d columns but %d names given"
+                    % (nf, len(self._all_names)))
         if user_nrows is not None:
             self.size = min(self.size, int(user_nrows))
         if skip_set:
@@ -106,7 +133,8 @@ class CSVFile(FileType):
         phys_lo = self._phys(lo)
         skiprows = sorted(set([j for j in extra_skip if j >= phys_lo])
                           | set(range(phys_lo)))
-        df = self._pd.read_csv(
+        import pandas as pd
+        df = pd.read_csv(
             self.path, names=list(self._all_names), header=None,
             skiprows=skiprows,
             nrows=hi - lo,  # pandas nrows counts PARSED rows

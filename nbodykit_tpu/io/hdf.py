@@ -28,7 +28,17 @@ class HDFFile(FileType):
         self._columns = {}
         self.attrs = {}
         with h5py.File(path, 'r') as ff:
+            if dataset not in ff and dataset != '/':
+                raise ValueError("no such group/dataset %r in %s"
+                                 % (dataset, path))
             obj = ff[dataset]
+            if exclude and not isinstance(obj, h5py.Dataset):
+                # exclude is meaningless for a single structured
+                # dataset (silently ignored there, as before)
+                bad = [e for e in exclude if e not in obj.keys()]
+                if bad:
+                    raise ValueError("exclude names not in %r: %s"
+                                     % (dataset, bad))
             self.attrs.update(dict(obj.attrs))
             if isinstance(obj, h5py.Dataset):
                 if obj.dtype.names is None:
@@ -50,6 +60,9 @@ class HDFFile(FileType):
                               else (name, d.dtype))
                 if len(set(sizes.values())) > 1:
                     raise ValueError("dataset size mismatch: %s" % sizes)
+                if not sizes:
+                    raise ValueError("no datasets under %r in %s"
+                                     % (dataset, path))
                 self.size = next(iter(sizes.values()))
                 self.dtype = np.dtype(dt)
 

@@ -1,0 +1,228 @@
+"""IO corner cases ported from the reference suites
+(nbodykit/io/tests/{test_base,test_csv,test_binary,test_hdf,
+test_stack}.py) — the failure modes and selection semantics the happy
+paths in test_io.py do not reach.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from nbodykit_tpu.io.csv import CSVFile
+from nbodykit_tpu.io.binary import BinaryFile
+from nbodykit_tpu.io.hdf import HDFFile
+from nbodykit_tpu.io.stack import FileStack
+
+try:
+    import h5py
+except ImportError:
+    h5py = None
+
+
+# ---------------------------------------------------------------------------
+# FileType selection semantics (reference io/tests/test_base.py)
+
+def _csv5(tmp_path, n=100, fmt='%.7e'):
+    data = np.random.RandomState(0).uniform(size=(n, 5))
+    path = str(tmp_path / 'data.txt')
+    np.savetxt(path, data, fmt=fmt)
+    return data, CSVFile(path, names=list('abcde'))
+
+
+def test_getitem_semantics(tmp_path):
+    data, f = _csv5(tmp_path)
+
+    with pytest.raises(IndexError):
+        f[[]]                       # empty column selection
+    with pytest.raises(IndexError):
+        f['a']['a']                 # cannot column-slice twice
+    with pytest.raises(IndexError):
+        f[['BAD1', 'BAD2']]         # unknown columns
+
+    f2 = f[['a', 'b']]
+    assert f2.columns == ['a', 'b']
+    f3 = f2[['a']]
+    assert f3.columns == ['a']
+    with pytest.raises(IndexError):
+        f2[['c']]                   # column outside the restricted view
+
+    # a single-column view slices to a plain array
+    np.testing.assert_allclose(f['a'][:], data[:, 0], rtol=1e-6)
+    np.testing.assert_allclose(f['a'][10:20], data[10:20, 0], rtol=1e-6)
+
+    # boolean mask and integer-list row selection
+    valid = np.random.RandomState(1).choice([True, False], size=len(f))
+    np.testing.assert_allclose(f[valid]['a'], data[valid, 0], rtol=1e-6)
+    np.testing.assert_allclose(f[np.array([0, 1, 2])]['b'],
+                               data[[0, 1, 2], 1], rtol=1e-6)
+
+
+def test_asarray(tmp_path):
+    data, f = _csv5(tmp_path)
+    d = f.asarray()
+    assert d.shape == (100, 5)
+    np.testing.assert_allclose(d, data, rtol=1e-6)
+    np.testing.assert_allclose(f[['a', 'b']].asarray(), data[:, :2],
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# CSV corner cases (reference io/tests/test_csv.py)
+
+def test_csv_no_trailing_newline(tmp_path):
+    path = str(tmp_path / 'nonewline.txt')
+    with open(path, 'w') as ff:
+        ff.write("1 1 1 1\n2 2 2 2")    # no trailing newline
+    f = CSVFile(path, names=list('abcd'), dtype='i4')
+    assert f.size == 2
+    np.testing.assert_array_equal(
+        f.asarray(), np.array([[1, 1, 1, 1], [2, 2, 2, 2]]))
+
+
+def test_csv_leading_blank_lines(tmp_path):
+    data = np.random.RandomState(2).uniform(size=(100, 5))
+    path = str(tmp_path / 'blank.txt')
+    with open(path, 'w') as ff:
+        ff.write("\n\n\n")
+        np.savetxt(ff, data, fmt='%.7e')
+    f = CSVFile(path, names=list('abcde'))
+    assert f.size == 100
+    np.testing.assert_allclose(f['a'][:], data[:, 0], rtol=1e-6)
+
+
+def test_csv_dtype_forms(tmp_path):
+    data, _ = _csv5(tmp_path)
+    path = str(tmp_path / 'data.txt')
+    f = CSVFile(path, names=list('abcde'),
+                dtype={'a': 'f4', 'b': 'i8', 'c': 'f8'})
+    assert f.dtype['a'] == 'f4'
+    assert f.dtype['b'] == 'i8'
+    assert f.dtype['c'] == 'f8'
+    f = CSVFile(path, names=list('abcde'), dtype='f4')
+    assert all(f.dtype[c] == 'f4' for c in 'abcde')
+
+
+def test_csv_wrong_names(tmp_path):
+    data, _ = _csv5(tmp_path)
+    path = str(tmp_path / 'data.txt')
+    with pytest.raises(ValueError):
+        CSVFile(path, names=['a', 'b', 'c'])   # 5 columns in the file
+
+
+def test_csv_invalid_keywords(tmp_path):
+    data, _ = _csv5(tmp_path)
+    path = str(tmp_path / 'data.txt')
+    for k, v in [('index_col', True), ('header', True),
+                 ('skipfooter', True)]:
+        with pytest.raises(ValueError):
+            CSVFile(path, names=list('abcde'), **{k: v})
+
+
+def test_csv_pickle(tmp_path):
+    data, f = _csv5(tmp_path)
+    f2 = pickle.loads(pickle.dumps(f))
+    np.testing.assert_allclose(f2['a'][:], data[:, 0], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Binary corner cases (reference io/tests/test_binary.py)
+
+def _binfile(tmp_path, header=0):
+    rng = np.random.RandomState(3)
+    pos = rng.uniform(size=(1024, 3))
+    vel = rng.uniform(size=(1024, 3))
+    path = str(tmp_path / 'data.bin')
+    with open(path, 'wb') as ff:
+        if header:
+            np.arange(header // 8, dtype='i8').tofile(ff)
+        pos.tofile(ff)
+        vel.tofile(ff)
+    dtype = [('Position', ('f8', 3)), ('Velocity', ('f8', 3))]
+    return pos, vel, path, dtype
+
+
+def test_binary_offsets(tmp_path):
+    pos, vel, path, dtype = _binfile(tmp_path)
+    f = BinaryFile(path, dtype, size=1024,
+                   offsets={'Position': 0, 'Velocity': pos.nbytes})
+    np.testing.assert_array_equal(
+        f.read(['Velocity'], 0, 1024)['Velocity'], vel)
+    with pytest.raises(ValueError):
+        BinaryFile(path, dtype, size=1024, offsets={'Position': 0})
+    with pytest.raises(TypeError):
+        BinaryFile(path, dtype, size=1024, offsets=[('Position', 0)])
+
+
+def test_binary_header_and_infer(tmp_path):
+    pos, vel, path, dtype = _binfile(tmp_path, header=80)
+    f = BinaryFile(path, dtype, header_size=80)
+    assert f.size == 1024        # inferred through the header
+    np.testing.assert_array_equal(
+        f.read(['Position'], 0, 1024)['Position'], pos)
+    with pytest.raises(ValueError):
+        BinaryFile(path, dtype, header_size=79)   # misaligned payload
+
+
+def test_binary_pickle(tmp_path):
+    pos, vel, path, dtype = _binfile(tmp_path)
+    f = BinaryFile(path, dtype, size=1024)
+    f2 = pickle.loads(pickle.dumps(f))
+    np.testing.assert_array_equal(
+        f2.read(['Position'], 10, 20)['Position'], pos[10:20])
+
+
+# ---------------------------------------------------------------------------
+# HDF corner cases (reference io/tests/test_hdf.py)
+
+@pytest.mark.skipif(h5py is None, reason="h5py not installed")
+def test_hdf_nonzero_root_and_exclude(tmp_path):
+    path = str(tmp_path / 'data.h5')
+    rng = np.random.RandomState(4)
+    pos = rng.uniform(size=(64, 3))
+    mass = rng.uniform(size=64)
+    with h5py.File(path, 'w') as ff:
+        ff.create_dataset('X/Position', data=pos)
+        g = ff.create_group('Y')
+        g.create_dataset('Position', data=pos)
+        g.create_dataset('Mass', data=mass)
+
+    f = HDFFile(path, dataset='Y')
+    assert sorted(f.columns) == ['Mass', 'Position']
+    with pytest.raises(ValueError):
+        HDFFile(path, dataset='Z')
+
+    f = HDFFile(path, dataset='Y', exclude=['Mass'])
+    assert f.columns == ['Position']
+    with pytest.raises(ValueError):
+        HDFFile(path, dataset='Y', exclude=['Nope'])
+
+
+@pytest.mark.skipif(h5py is None, reason="h5py not installed")
+def test_hdf_size_mismatch_and_empty(tmp_path):
+    path = str(tmp_path / 'mismatch.h5')
+    rng = np.random.RandomState(5)
+    with h5py.File(path, 'w') as ff:
+        ff.create_dataset('Mass', data=rng.uniform(size=512))
+        ff.create_dataset('Position', data=rng.uniform(size=(1024, 3)))
+    with pytest.raises(ValueError):
+        HDFFile(path)
+    f = HDFFile(path, exclude=['Mass'])
+    assert f.size == 1024
+
+    empty = str(tmp_path / 'empty.h5')
+    with h5py.File(empty, 'w') as ff:
+        ff.create_group('G')
+    with pytest.raises(ValueError):
+        HDFFile(empty, dataset='G')
+
+
+# ---------------------------------------------------------------------------
+# Stack corner cases (reference io/tests/test_stack.py)
+
+def test_stack_single_and_bad_path(tmp_path):
+    pos, vel, path, dtype = _binfile(tmp_path)
+    s = FileStack(BinaryFile, path, dtype, size=1024)
+    assert s.nfiles == 1 and s.size == 1024
+    with pytest.raises(FileNotFoundError):
+        FileStack(BinaryFile, str(tmp_path / 'nope.*'), dtype)
