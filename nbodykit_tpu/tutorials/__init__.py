@@ -29,6 +29,120 @@ def DemoHaloCatalog(simname='fake', halo_finder='fof', redshift=0.5,
     return cat
 
 
-def download_example_data(*args, **kwargs):
-    raise RuntimeError("this environment has no network egress; demo "
-                       "data is generated locally (DemoHaloCatalog)")
+# ---------------------------------------------------------------------------
+# offline example-data store (reference: tutorials/wget.py:61-198 —
+# download_example_data/available_examples pull files from a NERSC data
+# mirror; this environment has no egress, so the same API *generates*
+# the example files locally, deterministically, in the formats the
+# framework reads)
+
+def _write_csv(path, rng):
+    data = rng.uniform(0, 1000.0, size=(1024, 7))
+    np.savetxt(path, data, fmt='%.7e',
+               header='ra dec z x y z_cart w', comments='# ')
+
+
+def _write_hdf(path, rng):
+    import h5py
+    with h5py.File(path, 'w') as ff:
+        g = ff.create_group('Data')
+        g.create_dataset('Position',
+                         data=rng.uniform(0, 250.0, size=(2048, 3)))
+        g.create_dataset('Velocity',
+                         data=rng.normal(0, 300.0, size=(2048, 3)))
+        g.create_dataset('Mass', data=10 ** rng.uniform(12, 15, 2048))
+
+
+def _write_bigfile(path, rng):
+    from ..io.bigfile import BigFileWriter
+    w = BigFileWriter(path)
+    w.write('Position', rng.uniform(0, 250.0, size=(2048, 3))
+            .astype('f4'))
+    w.write('Velocity', rng.normal(0, 300.0, size=(2048, 3))
+            .astype('f4'))
+    w.write_attrs('Header', {'BoxSize': [250.0] * 3, 'Nmesh': 64})
+
+
+def _write_binary(path, rng):
+    with open(path, 'wb') as ff:
+        rng.uniform(0, 250.0, size=(1024, 3)).astype('f4').tofile(ff)
+        rng.normal(0, 300.0, size=(1024, 3)).astype('f4').tofile(ff)
+
+
+def _write_fits(path, rng):
+    # minimal BINTABLE written by hand (no astropy needed), matching
+    # what io/fits.py parses
+    n = 512
+    ra = rng.uniform(0, 360.0, n).astype('>f8')
+    dec = rng.uniform(-10.0, 10.0, n).astype('>f8')
+    z = rng.uniform(0.3, 0.7, n).astype('>f8')
+    rec = np.empty(n, dtype=[('RA', '>f8'), ('DEC', '>f8'),
+                             ('Z', '>f8')])
+    rec['RA'], rec['DEC'], rec['Z'] = ra, dec, z
+
+    def card(key, value, comment=''):
+        return ('%-8s= %20s / %-47s' % (key, value, comment))[:80]
+
+    def block(cards):
+        s = ''.join(c.ljust(80) for c in cards)
+        return s + ' ' * ((-len(s)) % 2880)
+
+    primary = block([card('SIMPLE', 'T'), card('BITPIX', '8'),
+                     card('NAXIS', '0'), 'END'])
+    hdr = block([card('XTENSION', "'BINTABLE'"), card('BITPIX', '8'),
+                 card('NAXIS', '2'), card('NAXIS1', str(rec.dtype.itemsize)),
+                 card('NAXIS2', str(n)), card('PCOUNT', '0'),
+                 card('GCOUNT', '1'), card('TFIELDS', '3'),
+                 card('TTYPE1', "'RA      '"), card('TFORM1', "'D       '"),
+                 card('TTYPE2', "'DEC     '"), card('TFORM2', "'D       '"),
+                 card('TTYPE3', "'Z       '"), card('TFORM3', "'D       '"),
+                 'END'])
+    payload = rec.tobytes()
+    payload += b'\0' * ((-len(payload)) % 2880)
+    with open(path, 'wb') as ff:
+        ff.write(primary.encode('ascii'))
+        ff.write(hdr.encode('ascii'))
+        ff.write(payload)
+
+
+_EXAMPLES = {
+    'csv-example.txt': _write_csv,
+    'hdf-example.hdf5': _write_hdf,
+    'bigfile-example': _write_bigfile,
+    'binary-example.bin': _write_binary,
+    'fits-example.fits': _write_fits,
+}
+
+
+def available_examples():
+    """The example data files this offline store can materialize
+    (reference analog: tutorials/wget.py:128 lists the NERSC mirror)."""
+    return sorted(_EXAMPLES)
+
+
+def download_example_data(filenames, download_dirname=None, seed=2024):
+    """Materialize example data files locally (reference analog:
+    tutorials/wget.py:152 downloads them; zero-egress here, so the
+    files are generated deterministically from ``seed`` instead —
+    byte-stable across calls, same API).
+
+    Parameters
+    ----------
+    filenames : str or list of str — names from
+        :func:`available_examples`
+    download_dirname : optional existing directory (default: cwd)
+    """
+    import os
+    if isinstance(filenames, str):
+        filenames = [filenames]
+    if download_dirname is not None and not os.path.isdir(
+            download_dirname):
+        raise ValueError("specified download directory is not valid")
+    for filename in filenames:
+        if filename not in _EXAMPLES:
+            raise ValueError(
+                "no such example file '%s'\n\navailable examples "
+                "are: %s" % (filename, available_examples()))
+        target = filename if download_dirname is None else \
+            os.path.join(download_dirname, filename)
+        _EXAMPLES[filename](target, np.random.RandomState(seed))
