@@ -616,33 +616,39 @@ def cmd_worker():
     detail['state'] = 'running'
     _flush_detail(detail)
 
-    # paint microbench at a mid scale, both kernels; the winner paints
-    # the ladder (scatter-add vs sort+unique-scatter is a hardware
-    # question — TPU scatter serializes on collisions, sort costs
-    # O(n log^2 n) bitonic passes)
-    results = {}
-    for method in ('scatter', 'sort', 'mxu'):
-        try:
-            p = run_paint(256, 1_000_000, method=method)
-            detail['paint'].append(p)
-            note("paint micro: %s" % p)
-            results[method] = p['value']  # wallclock, unrounded enough
-        except Exception as e:
-            detail['paint'].append({"method": method,
-                                    "error": str(e)[:300]})
-            note("paint micro (%s) failed: %s" % (method, e))
-    # winner = fastest SUCCEEDED method (a failed kernel must never
-    # paint the ladder); default scatter only when both failed
-    best_method = min(results, key=results.get) if results \
-        else 'scatter'
-    detail['paint_method'] = best_method
-    note("ladder paint method: %s" % best_method)
+    # paint microbench, all three kernels, at TWO scales: the winner at
+    # 256^3/1e6 paints the small rungs, the winner at 512^3/1e7 paints
+    # the >=512 rungs (kernel ranking is scale-dependent: scatter is
+    # latency-bound per element, sort pays O(n log^2 n) bitonic passes,
+    # mxu pays a fixed matmul/onehot overhead that amortizes at scale)
+    def tune(Nmesh, Npart):
+        results = {}
+        for method in ('scatter', 'sort', 'mxu'):
+            try:
+                p = run_paint(Nmesh, Npart, method=method)
+                detail['paint'].append(p)
+                note("paint micro: %s" % p)
+                results[method] = p['value']
+            except Exception as e:
+                detail['paint'].append({"method": method,
+                                        "error": str(e)[:300]})
+                note("paint micro (%s) failed: %s" % (method, e))
+            _flush_detail(detail)
+        # fastest SUCCEEDED method (a failed kernel must never paint
+        # the ladder); scatter only when all failed
+        return min(results, key=results.get) if results else 'scatter'
+
+    best_small = tune(256, 1_000_000)
+    on_tpu = detail['probe'].get('platform') in TPU_PLATFORMS
+    best_big = tune(512, 10_000_000) if on_tpu else best_small
+    detail['paint_method'] = {'small': best_small, 'big': best_big}
+    note("ladder paint methods: <512 %s, >=512 %s"
+         % (best_small, best_big))
     _flush_detail(detail)
 
     # smallest-first ladder up to the north-star config; every step is
     # sized to finish (clean Python exceptions, e.g. OOM, do NOT wedge
     # the tunnel — only kills do, and nobody kills us)
-    on_tpu = detail['probe'].get('platform') in TPU_PLATFORMS
     if on_tpu:
         ladder = [(128, 100_000), (256, 1_000_000), (512, 10_000_000),
                   (1024, 10_000_000), (1024, 100_000_000)]
@@ -670,7 +676,9 @@ def cmd_worker():
         detail['state'] = 'config_nmesh%d_npart%.0e' % (Nmesh, Npart)
         _flush_detail(detail)
         try:
-            res = run_config(Nmesh, Npart, method=best_method)
+            res = run_config(
+                Nmesh, Npart,
+                method=best_big if Nmesh >= 512 else best_small)
             detail['configs'].append(res)
             _cache_tpu_result(res)
             _cache_cpu_baseline(res)

@@ -238,3 +238,57 @@ class FITSFile(FileType):
             # trailing axis instead of casting elementwise
             out[col] = vals.astype(self.dtype[col].base)
         return out
+
+
+def write_bintable(path, cols):
+    """Write a minimal standards-conforming single-BINTABLE FITS file
+    (2880-byte header blocks of 80-char cards, big-endian records) —
+    the writing counterpart of the native parser above, kept in this
+    module so the two conventions evolve together. ``cols`` is a list
+    of (name, array) pairs; f4/f8/i4/i8 scalars or fixed-width vectors.
+
+    The reference has no FITS writer at all (fitsio/astropy handled
+    it); this one covers the catalog-interchange subset.
+    """
+    def card(key, val, quote=False):
+        if quote:
+            v = "'%s'" % val
+        elif isinstance(val, bool):
+            v = 'T' if val else 'F'
+        else:
+            v = str(val)
+        return ('%-8s= %20s' % (key, v)).ljust(80).encode('ascii')
+
+    def block(cards):
+        raw = b''.join(cards) + b'END'.ljust(80, b' ')
+        return raw.ljust(((len(raw) + 2879) // 2880) * 2880, b' ')
+
+    fields = []
+    for name, arr in cols:
+        arr = np.asarray(arr)
+        letter = {'f8': 'D', 'f4': 'E', 'i4': 'J', 'i8': 'K'}[
+            arr.dtype.str[1:]]
+        rep = arr.shape[1] if arr.ndim > 1 else 1
+        fields.append((name, arr, '%d%s' % (rep, letter)))
+    dt = np.dtype([(n, a.dtype.newbyteorder('>'),
+                    (a.shape[1],) if a.ndim > 1 else ())
+                   for n, a, _ in fields])
+    nrows = len(fields[0][1])
+    rec = np.zeros(nrows, dtype=dt)
+    for n, a, _ in fields:
+        rec[n] = a
+
+    with open(path, 'wb') as f:
+        f.write(block([card('SIMPLE', True), card('BITPIX', 8),
+                       card('NAXIS', 0)]))
+        hdr = [card('XTENSION', 'BINTABLE', quote=True),
+               card('BITPIX', 8), card('NAXIS', 2),
+               card('NAXIS1', dt.itemsize), card('NAXIS2', nrows),
+               card('PCOUNT', 0), card('GCOUNT', 1),
+               card('TFIELDS', len(fields))]
+        for i, (n, _, tform) in enumerate(fields):
+            hdr.append(card('TTYPE%d' % (i + 1), n, quote=True))
+            hdr.append(card('TFORM%d' % (i + 1), tform, quote=True))
+        f.write(block(hdr))
+        raw = rec.tobytes()
+        f.write(raw.ljust(((len(raw) + 2879) // 2880) * 2880, b'\0'))

@@ -19,10 +19,15 @@ fuses and tiles cleanly. Particles are chunked with a fori_loop to bound
 the live set.
 """
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .window import window_weights, window_support
+
+# default cap on the mxu paint's per-piece one-hot Z expansion; shared
+# with pmesh.memory_plan so the estimate tracks the kernel
+ZCHUNK_BYTES = 1 << 28
 
 
 def _axis_terms(pos_ax, resampler, period):
@@ -337,7 +342,7 @@ def _bucket_by_argsort(key, n, B, Kcap):
 
 def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
                     origin=0, out=None, rb=8, cb=8, slack=2.0,
-                    return_overflow=False):
+                    return_overflow=False, zchunk_bytes=ZCHUNK_BYTES):
     """Scatter particles onto a local mesh block via MXU matmuls.
 
     TPU has no scatter atomics and XLA lowers scatter-add to a serial
@@ -449,6 +454,20 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
     # trash bucket so they cannot crowd real buckets into overflow
     key = jnp.where(keep, txf * nty + ty, B)
 
+    # ---- per-stripe deposit: batched matmul over the y tiles -----------
+    # bound the one-hot Z expansion's live size: each stripe's K axis
+    # is processed in pieces of ck slots per bucket so the (nty*ck, N2)
+    # Z block stays under ~zchunk_bytes (at 1024^3/1e8 an unchunked
+    # stripe Z would be 6.4 GB — OOM next to the mesh). npieces is
+    # chosen first and ck = ceil(Kcap/npieces), so the Kcap padding to
+    # a piece multiple is bounded by 8*npieces slots (sizing ck first
+    # could inflate the padded payload by up to ~2x)
+    zrow = max(nty * N2 * np.dtype(dtype).itemsize, 1)
+    npieces = max(1, -(-Kcap * zrow // max(int(zchunk_bytes), zrow * 8)))
+    ck = max(8, -(-Kcap // npieces))
+    ck = -(-ck // 8) * 8
+    Kcap = npieces * ck              # pieces tile Kcap exactly
+
     src, overflow = _bucket_by_argsort(key, n, B, Kcap)
     vsrc = src < n
     srcc = jnp.minimum(src, max(n - 1, 0))
@@ -456,18 +475,16 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
     pmass = jnp.where(vsrc & jnp.take(keep, srcc), jnp.take(mass, srcc),
                       jnp.zeros((), dtype))
 
-    # ---- per-stripe deposit: batched matmul over the y tiles -----------
-    KX = nty * Kcap
-    xs = (ppos.reshape(ntx + 1, KX, 3), pmass.reshape(ntx + 1, KX))
+    KX = nty * ck
+    xs = (ppos.reshape(ntx + 1, nty, npieces, ck, 3),
+          pmass.reshape(ntx + 1, nty, npieces, ck))
     col_i = jax.lax.broadcasted_iota(jnp.int32, (KX, M), 1)
     z_i = jax.lax.broadcasted_iota(jnp.int32, (KX, N2), 1)
-    ty_k = jnp.repeat(jnp.arange(nty, dtype=jnp.int32), Kcap)
+    ty_k = jnp.repeat(jnp.arange(nty, dtype=jnp.int32), ck)
 
     P0, P1 = (ntx + 1) * rb + s - 1, nty * cb + s - 1
 
-    def stripe(carry, xs):
-        mesh_pad, txi = carry
-        spos, smass = xs
+    def piece(txi, spos, smass):
         ii0, ww0 = window_weights(spos[:, 0], resampler)
         ii1, ww1 = window_weights(spos[:, 1], resampler)
         ii2, ww2 = window_weights(spos[:, 2], resampler)
@@ -488,10 +505,29 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
             zc = jnp.mod(ii2[:, c].astype(jnp.int32), N2)
             zw = ww2[:, c].astype(dtype)
             zm = zm + jnp.where(zc[:, None] == z_i, zw[:, None], 0)
-        blocks = jax.lax.dot_general(
-            w0y.reshape(nty, Kcap, M), zm.reshape(nty, Kcap, N2),
+        return jax.lax.dot_general(
+            w0y.reshape(nty, ck, M), zm.reshape(nty, ck, N2),
             dimension_numbers=(((1,), (1,)), ((0,), (0,))),
             preferred_element_type=dtype)          # (nty, M, N2)
+
+    def stripe(carry, xs):
+        mesh_pad, txi = carry
+        spos, smass = xs                  # (nty, npieces, ck, [3])
+        spos_p = spos.transpose(1, 0, 2, 3)    # piece-major
+        smass_p = smass.transpose(1, 0, 2)
+
+        def body(j, blocks):
+            return blocks + piece(
+                txi,
+                jax.lax.dynamic_index_in_dim(
+                    spos_p, j, keepdims=False).reshape(KX, 3),
+                jax.lax.dynamic_index_in_dim(
+                    smass_p, j, keepdims=False).reshape(KX))
+
+        # data-derived zero init (shard_map varying-manual-axes, as
+        # for the scan carry below)
+        blocks0 = jnp.zeros((nty, M, N2), dtype) + smass.ravel()[0] * 0
+        blocks = jax.lax.fori_loop(0, npieces, body, blocks0)
         # fold the y tiles into a (rbh, P1, N2) slab: interior cols by
         # reshape, halo cols by a cb-shifted dense add
         blocks = blocks.reshape(nty, rbh, cbh, N2).transpose(1, 0, 2, 3)

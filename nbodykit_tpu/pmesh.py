@@ -579,7 +579,15 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
         rbh, cbh = rb + s - 1, cb + s - 1
         n0l = max(int(N[0]) // ndev, 1)
         ntx = max(-(-n0l // rb), 1)
-        stripe = slack * nl / ntx * (rbh * cbh + int(N[2])) * item
+        # the kernel K-chunks each stripe so the one-hot Z expansion is
+        # capped (ops/paint.py ZCHUNK_BYTES); the per-stripe blocks
+        # accumulator (nty, M, N2) stays live across all pieces
+        from .ops.paint import ZCHUNK_BYTES
+        nty = max(-(-int(N[1]) // cb), 1)
+        blocks_acc = nty * rbh * cbh * int(N[2]) * item
+        stripe = min(slack * nl / ntx * (rbh * cbh + int(N[2])) * item,
+                     float(ZCHUNK_BYTES) * (1 + rbh * cbh / int(N[2]))
+                     ) + blocks_acc
         paint_tmp = (slack * nl * 4 * item     # padded pos+mass
                      + nl * 8 * 2              # sort keys + order
                      + stripe

@@ -70,39 +70,14 @@ def _write_binary(path, rng):
 
 
 def _write_fits(path, rng):
-    # minimal BINTABLE written by hand (no astropy needed), matching
-    # what io/fits.py parses
+    # the native writer lives next to the native parser (io/fits.py)
+    # so the two conventions evolve together
+    from ..io.fits import write_bintable
     n = 512
-    ra = rng.uniform(0, 360.0, n).astype('>f8')
-    dec = rng.uniform(-10.0, 10.0, n).astype('>f8')
-    z = rng.uniform(0.3, 0.7, n).astype('>f8')
-    rec = np.empty(n, dtype=[('RA', '>f8'), ('DEC', '>f8'),
-                             ('Z', '>f8')])
-    rec['RA'], rec['DEC'], rec['Z'] = ra, dec, z
-
-    def card(key, value, comment=''):
-        return ('%-8s= %20s / %-47s' % (key, value, comment))[:80]
-
-    def block(cards):
-        s = ''.join(c.ljust(80) for c in cards)
-        return s + ' ' * ((-len(s)) % 2880)
-
-    primary = block([card('SIMPLE', 'T'), card('BITPIX', '8'),
-                     card('NAXIS', '0'), 'END'])
-    hdr = block([card('XTENSION', "'BINTABLE'"), card('BITPIX', '8'),
-                 card('NAXIS', '2'), card('NAXIS1', str(rec.dtype.itemsize)),
-                 card('NAXIS2', str(n)), card('PCOUNT', '0'),
-                 card('GCOUNT', '1'), card('TFIELDS', '3'),
-                 card('TTYPE1', "'RA      '"), card('TFORM1', "'D       '"),
-                 card('TTYPE2', "'DEC     '"), card('TFORM2', "'D       '"),
-                 card('TTYPE3', "'Z       '"), card('TFORM3', "'D       '"),
-                 'END'])
-    payload = rec.tobytes()
-    payload += b'\0' * ((-len(payload)) % 2880)
-    with open(path, 'wb') as ff:
-        ff.write(primary.encode('ascii'))
-        ff.write(hdr.encode('ascii'))
-        ff.write(payload)
+    write_bintable(path, [
+        ('RA', rng.uniform(0, 360.0, n)),
+        ('DEC', rng.uniform(-10.0, 10.0, n)),
+        ('Z', rng.uniform(0.3, 0.7, n))])
 
 
 _EXAMPLES = {
