@@ -488,6 +488,55 @@ def run_fkp(Nmesh=512, nbar=1e-4, reps=1):
     return rec
 
 
+def run_prim(n=10_000_000, reps=3):
+    """Per-element costs of the irregular primitives every paint
+    strategy is built from — measured on the actual backend, because
+    the scatter/sort/gather rates decide which kernel wins and none of
+    them are predictable from specs (TPU scatter serializes; sort is a
+    bitonic network; gather throughput varies with layout).
+    """
+    jax = _setup_jax()
+    import jax.numpy as jnp
+
+    key = jax.random.key(11)
+    M = 134_217_728  # 512^3
+    idx = jax.random.randint(key, (n,), 0, M, jnp.int32)
+    perm = jax.random.permutation(key, n).astype(jnp.int32)
+    vals = jax.random.uniform(key, (n,), jnp.float32)
+    small = jax.random.randint(key, (n,), 0, 4096, jnp.int32)
+    _sync(jax, (idx, perm, vals, small))
+
+    out = {}
+
+    def t(name, fn, *args):
+        f = jax.jit(fn)
+        try:
+            _sync(jax, f(*args))                 # compile + warm
+            t0 = time.time()
+            for _ in range(reps):
+                _sync(jax, f(*args))
+            dt = (time.time() - t0) / reps
+            out[name] = {"s": round(dt, 4),
+                         "ns_per_elt": round(dt / n * 1e9, 2)}
+        except Exception as e:
+            out[name] = {"error": str(e)[:200]}
+
+    big = jnp.zeros(M, jnp.float32)
+    t('scatter_add_colliding',
+      lambda b, i, v: b.at[i].add(v), big, idx, vals)
+    t('scatter_unique_perm',
+      lambda i, v: jnp.zeros(n, jnp.float32).at[i].set(
+          v, unique_indices=True), perm, vals)
+    t('gather_random', lambda v, i: v[i], vals, perm)
+    t('argsort_i32', lambda k: jnp.argsort(k), idx)
+    t('sort_pair', lambda k, v: jax.lax.sort((k, v), num_keys=1),
+      idx, vals)
+    t('argsort_small_key', lambda k: jnp.argsort(k), small)
+    t('cumsum', lambda v: jnp.cumsum(v), vals)
+    return {"metric": "prim_microbench_n%.0e" % n, "n": n,
+            "platform": jax.devices()[0].platform, "prims": out}
+
+
 def run_paint(Nmesh, Npart, method='scatter', reps=3):
     """Paint-only microbenchmark (the #1 perf risk, SURVEY §7)."""
     jax = _setup_jax()
@@ -881,6 +930,10 @@ if __name__ == '__main__':
     if argv[0] == '--config':
         print(json.dumps(run_config(int(argv[1]), int(argv[2]),
                                     *(argv[3:4] or ['scatter']))))
+        sys.exit(0)
+    if argv[0] == '--prim':
+        print(json.dumps(run_prim(int(argv[1]) if argv[1:]
+                                  else 10_000_000)))
         sys.exit(0)
     if argv[0] == '--fkp':
         print(json.dumps(run_fkp(int(argv[1]) if argv[1:] else 512)))
