@@ -160,25 +160,30 @@ class BinnedStatistic(object):
             if key in self._vars:
                 return self._vars[key]
             raise KeyError("no variable named %r" % key)
-        # list of variables -> subset copy
-        if isinstance(key, list) and all(isinstance(k, str) for k in key):
+        # list/tuple of variables -> subset copy
+        if isinstance(key, (list, tuple)) and \
+                all(isinstance(k, str) for k in key):
             missing = [k for k in key if k not in self._vars]
             if missing:
                 raise KeyError("no variables named %s" % missing)
             new = self.copy()
             new._vars = {k: self._vars[k].copy() for k in key}
             return new
-        # positional slicing: keep dimensionality, slice edges too
+        # positional slicing (reference Dataset semantics: an integer
+        # index SQUEEZES its dimension, a list keeps it, and selecting
+        # a single element — every dim squeezed — is an error)
         key = (key,) if not isinstance(key, tuple) else key
         if len(key) > len(self.dims):
             raise IndexError("too many indices")
         indices = []
+        squeeze_dims = []
         for i, d in enumerate(self.dims):
             n = self.shape[i]
             if i < len(key):
                 k = key[i]
-                if isinstance(k, int):
-                    idx = np.array([k % n])
+                if isinstance(k, (int, np.integer)):
+                    idx = np.array([int(k) % n])
+                    squeeze_dims.append(d)
                 elif isinstance(k, slice):
                     idx = np.arange(n)[k]
                 else:
@@ -186,7 +191,14 @@ class BinnedStatistic(object):
             else:
                 idx = np.arange(n)
             indices.append(idx)
-        return self._take_indices(indices)
+        if len(squeeze_dims) == len(self.dims):
+            raise IndexError(
+                "cannot access a single element; use [var] access plus "
+                "numpy indexing instead")
+        out = self._take_indices(indices)
+        for d in squeeze_dims:
+            out = out.squeeze(d)
+        return out
 
     # -- construction helpers ---------------------------------------------
 

@@ -40,8 +40,24 @@ def test_getitem_variable_and_slice():
     sliced = ds[2:5]
     assert sliced.shape == (3, 5)
     np.testing.assert_allclose(sliced['power'], ds['power'][2:5])
+    # reference Dataset semantics: an int index squeezes the dim, a
+    # list keeps it, and single-element access raises
     col = ds[:, 0]
-    assert col.shape == (10, 1)
+    assert col.shape == (10,) and col.dims == ['k']
+    col2 = ds[:, [0]]
+    assert col2.shape == (10, 1) and col2.dims == ['k', 'mu']
+    both = ds[:, [0, -1]]
+    assert both.shape == (10, 2)
+    np.testing.assert_allclose(both['power'], ds['power'][:, [0, -1]])
+    tup = ds[('k', 'power')]
+    assert set(tup.variables) == {'k', 'power'}
+    import pytest
+    with pytest.raises(KeyError):
+        ds[['k', 'nope']]
+    with pytest.raises(IndexError):
+        ds[0, 0]
+    with pytest.raises(IndexError):
+        ds[0, 0, 0]
 
 
 def test_sel_and_squeeze():
