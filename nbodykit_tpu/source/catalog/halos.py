@@ -17,19 +17,21 @@ RHO_CRIT = 2.7754e11  # (M_sun/h) / (Mpc/h)^3
 
 def halo_mass_definition(mdef, cosmo, redshift):
     """The mean overdensity threshold for a mass definition: 'vir'
-    (Bryan & Norman 1998), '200m', '500c', ..."""
-    om = float(cosmo.Omega_m(redshift))
+    (Bryan & Norman 1998), '200m', '500c', ... ``redshift`` may be a
+    scalar or a per-object array (the reference passes arrays through
+    halotools; tests/test_transform.py:145)."""
+    om = np.asarray(cosmo.Omega_m(np.asarray(redshift)))
+    e2 = np.asarray(cosmo.efunc(np.asarray(redshift))) ** 2
     if mdef == 'vir':
         x = om - 1.0
         delta = 18 * np.pi ** 2 + 82 * x - 39 * x ** 2
-        return delta * RHO_CRIT * float(cosmo.efunc(redshift)) ** 2
+        return delta * RHO_CRIT * e2
     mult = float(mdef[:-1])
     kind = mdef[-1]
     if kind == 'm':
-        return mult * RHO_CRIT * om * float(
-            cosmo.efunc(redshift)) ** 2
+        return mult * RHO_CRIT * om * e2
     if kind == 'c':
-        return mult * RHO_CRIT * float(cosmo.efunc(redshift)) ** 2
+        return mult * RHO_CRIT * e2
     raise ValueError("unknown mass definition %r" % mdef)
 
 

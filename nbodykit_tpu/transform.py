@@ -25,6 +25,15 @@ def ConcatenateSources(*sources, **kwargs):
         columns = sources[0].columns
         for s in sources[1:]:
             columns = [c for c in columns if c in s.columns]
+    else:
+        if isinstance(columns, str):
+            columns = [columns]
+        for c in columns:
+            for s in sources:
+                if c not in s.columns:
+                    raise ValueError(
+                        "cannot concatenate column %r: not in every "
+                        "source (available: %s)" % (c, s.columns))
     data = {c: jnp.concatenate([s[c] for s in sources], axis=0)
             for c in columns}
     attrs = {}
@@ -40,9 +49,28 @@ def ConstantArray(value, size, chunks=None):
         (size,) + np.shape(np.asarray(value)))
 
 
+# ICRS -> galactic rotation (J2000; the standard IAU matrix used by
+# astropy's Galactic frame): v_gal = _ICRS_TO_GAL @ v_icrs
+_ICRS_TO_GAL = np.array([
+    [-0.0548755604162154, -0.8734370902348850, -0.4838350155487132],
+    [+0.4941094278755837, -0.4448296299600112, +0.7469822444972189],
+    [-0.8676661490190047, -0.1980763734312015, +0.4559837761750669]])
+
+
+def _check_frame(frame):
+    if frame not in ('icrs', 'galactic'):
+        raise ValueError("frame must be 'icrs' or 'galactic', got %r"
+                         % (frame,))
+
+
 def CartesianToEquatorial(pos, observer=[0, 0, 0], frame='icrs'):
-    """Cartesian -> (RA, Dec) degrees (reference transform.py:110)."""
+    """Cartesian -> (lon, lat) degrees in the requested frame
+    (reference transform.py:110; frame='galactic' applies the standard
+    ICRS->galactic rotation the reference gets from astropy)."""
+    _check_frame(frame)
     pos = jnp.asarray(pos) - jnp.asarray(observer, dtype=jnp.asarray(pos).dtype)
+    if frame == 'galactic':
+        pos = pos @ jnp.asarray(_ICRS_TO_GAL.T, dtype=pos.dtype)
     s = jnp.hypot(pos[..., 0], pos[..., 1])
     lon = jnp.degrees(jnp.arctan2(pos[..., 1], pos[..., 0])) % 360.0
     lat = jnp.degrees(jnp.arctan2(pos[..., 2], s))
@@ -63,10 +91,14 @@ def SkyToUnitSphere(ra, dec, degrees=True):
 
 
 def SkyToCartesian(ra, dec, redshift, cosmo, observer=[0, 0, 0],
-                   degrees=True):
-    """(RA, Dec, z) -> comoving Cartesian, in Mpc/h (reference
-    transform.py:331)."""
+                   degrees=True, frame='icrs'):
+    """(lon, lat, z) -> comoving Cartesian, in Mpc/h (reference
+    transform.py:331). ``frame='galactic'`` interprets (lon, lat) as
+    galactic coordinates and returns ICRS-aligned Cartesian."""
+    _check_frame(frame)
     pos = SkyToUnitSphere(ra, dec, degrees=degrees)
+    if frame == 'galactic':
+        pos = pos @ jnp.asarray(_ICRS_TO_GAL, dtype=pos.dtype)
     r = jnp.asarray(cosmo.comoving_distance(np.asarray(redshift)))
     return r[..., None] * pos + jnp.asarray(observer,
                                             dtype=pos.dtype)
@@ -79,8 +111,9 @@ def CartesianToSky(pos, cosmo, velocity=None, observer=[0, 0, 0],
     Redshift is inverted from the comoving distance on an interpolation
     grid out to ``zmax``.
     """
+    _check_frame(frame)
     pos = jnp.asarray(pos) - jnp.asarray(observer, dtype=jnp.asarray(pos).dtype)
-    ra, dec = CartesianToEquatorial(pos)
+    ra, dec = CartesianToEquatorial(pos, frame=frame)
     r = jnp.sqrt((pos ** 2).sum(axis=-1))
 
     zgrid = np.concatenate([[0.0], np.logspace(-8, np.log10(zmax), 1024)])

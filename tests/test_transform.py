@@ -100,3 +100,72 @@ def test_stack_concatenate_constant():
     assert cc.size == 8
     np.testing.assert_allclose(np.asarray(cc['x'])[3:],
                                np.arange(5.0))
+
+
+def test_galactic_frame_roundtrip():
+    """frame='galactic' must actually rotate (reference
+    tests/test_transform.py:76 checks the astropy-backed version;
+    here the standard IAU ICRS->galactic matrix)."""
+    import pytest
+    from nbodykit_tpu.cosmology import Planck15
+
+    rng = np.random.RandomState(42)
+    pos = jnp.asarray(rng.uniform(50.0, 300.0, (500, 3)))
+    lon, lat, z = transform.CartesianToSky(pos, Planck15,
+                                           frame='galactic')
+    ra, dec, _ = transform.CartesianToSky(pos, Planck15)
+    # a real rotation: galactic coords differ from equatorial
+    assert float(jnp.abs(jnp.asarray(lon) - jnp.asarray(ra)).max()) > 1
+    pos2 = transform.SkyToCartesian(lon, lat, z, Planck15,
+                                    frame='galactic')
+    np.testing.assert_allclose(np.asarray(pos2), np.asarray(pos),
+                               rtol=1e-4)
+    # the rotation matrix is orthonormal
+    from nbodykit_tpu.transform import _ICRS_TO_GAL
+    np.testing.assert_allclose(_ICRS_TO_GAL @ _ICRS_TO_GAL.T,
+                               np.eye(3), atol=1e-12)
+    # the galactic north pole is at (ra, dec) ~ (192.86, 27.13) deg:
+    # its ICRS unit vector must map to lat = +90
+    ngp = transform.SkyToUnitSphere(jnp.asarray([192.85948]),
+                                    jnp.asarray([27.12825]))
+    glon, glat = transform.CartesianToEquatorial(ngp, frame='galactic')
+    assert abs(float(glat[0]) - 90.0) < 1e-3
+
+    with pytest.raises(ValueError, match="frame"):
+        transform.CartesianToSky(pos, Planck15, frame='fk5')
+
+
+def test_halo_transforms_finite_and_scaling():
+    """Reference tests/test_transform.py:145 exercises HaloRadius/
+    HaloConcentration/HaloVelocityDispersion over random masses."""
+    from nbodykit_tpu.cosmology import Planck15
+    from nbodykit_tpu.transform import (HaloRadius, HaloConcentration,
+                                        HaloVelocityDispersion)
+
+    rng = np.random.RandomState(42)
+    mass = jnp.asarray(rng.uniform(1e12, 1e14, 1000))
+    zarr = jnp.asarray(rng.uniform(0.0, 1.0, 1000))
+    for zz in (zarr, 0.0):
+        r = HaloRadius(mass, Planck15, redshift=zz)
+        c = HaloConcentration(mass, Planck15, redshift=zz)
+        v = HaloVelocityDispersion(mass, Planck15, redshift=zz)
+        for arr in (r, c, v):
+            a = np.asarray(arr)
+            assert np.isfinite(a).all() and (a > 0).all()
+    # more massive halos are bigger and less concentrated
+    m2 = jnp.asarray([1e12, 1e15])
+    r2 = np.asarray(HaloRadius(m2, Planck15, redshift=0.0))
+    c2 = np.asarray(HaloConcentration(m2, Planck15, redshift=0.0))
+    assert r2[1] > r2[0] and c2[1] < c2[0]
+
+
+def test_concatenate_invalid_column():
+    import pytest
+    from nbodykit_tpu.lab import UniformCatalog
+
+    s1 = UniformCatalog(nbar=1e-4, BoxSize=100.0, seed=1)
+    s2 = UniformCatalog(nbar=1e-4, BoxSize=100.0, seed=2)
+    cat = transform.ConcatenateSources(s1, s2)
+    assert cat.size == s1.size + s2.size
+    with pytest.raises(ValueError):
+        transform.ConcatenateSources(s1, s2, columns='InvalidColumn')
