@@ -806,6 +806,17 @@ def cmd_worker():
                          "error": str(e)[:300]}
         note("fkp failed: %s" % str(e)[:200])
 
+    # irregular-primitive rates (diagnostic for the paint-kernel
+    # ranking; small safe programs)
+    detail['state'] = 'prim'
+    _flush_detail(detail)
+    try:
+        detail['prim'] = run_prim(10_000_000)
+        note("prim ok: %s" % detail['prim'])
+    except Exception as e:
+        detail['prim'] = {"error": str(e)[:300]}
+        note("prim failed: %s" % str(e)[:200])
+
     detail['state'] = 'done'
     detail['done'] = True
     detail['total_s'] = round(time.time() - detail['t0'], 1)
