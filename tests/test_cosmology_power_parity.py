@@ -1,0 +1,51 @@
+"""Remaining portable cases from the reference cosmology suites
+(cosmology/tests/test_power.py): error modes, deprecation shims, and
+the large-scale agreement of the linear/nonlinear/Zeldovich spectra."""
+
+import numpy as np
+import pytest
+
+from nbodykit_tpu.cosmology import (Cosmology, LinearPower, HalofitPower,
+                                    ZeldovichPower, EHPower,
+                                    NoWiggleEHPower)
+
+
+def test_bad_transfer():
+    with pytest.raises(ValueError):
+        LinearPower(Cosmology(), redshift=0., transfer="BAD")
+
+
+def test_deprecated_ehpower_shims():
+    c = Cosmology()
+    with pytest.warns(FutureWarning):
+        P1 = EHPower(c, redshift=0)
+    P2 = LinearPower(c, 0., transfer='EisensteinHu')
+    np.testing.assert_allclose(P1(0.1), P2(0.1))
+
+    with pytest.warns(FutureWarning):
+        P1 = NoWiggleEHPower(c, redshift=0)
+    P2 = LinearPower(c, 0., transfer='NoWiggleEisensteinHu')
+    np.testing.assert_allclose(P1(0.1), P2(0.1))
+
+
+def test_large_scales_agree():
+    """On linear scales every spectrum reduces to the linear one
+    (reference test_power.py:31)."""
+    c = Cosmology()
+    k = np.logspace(-5, -2, 50)
+    Plin = LinearPower(c, redshift=0)
+    Pnl = HalofitPower(c, redshift=0)
+    Pzel = ZeldovichPower(c, redshift=0)
+    np.testing.assert_allclose(np.asarray(Plin(k)), np.asarray(Pnl(k)),
+                               rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(Plin(k)), np.asarray(Pzel(k)),
+                               rtol=1e-2)
+
+
+def test_scalar_and_array_calls_consistent():
+    c = Cosmology()
+    P = LinearPower(c, redshift=0.5)
+    k = np.array([0.01, 0.1, 1.0])
+    arr = np.asarray(P(k))
+    for i, ki in enumerate(k):
+        np.testing.assert_allclose(float(P(ki)), arr[i], rtol=1e-10)
