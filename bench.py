@@ -335,8 +335,11 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     # then hangs every later compile RPC for ~27 min before
     # UNAVAILABLE) — go staged directly there; the three stages
     # compile fine separately and the intermediates never leave the
-    # device
-    staged = (rec['platform'] in TPU_PLATFORMS and Nmesh >= 512)
+    # device. Round-5: the FUSED mxu program wedged the tunnel at
+    # Nmesh=256 too (the paint-only mxu program had compiled fine
+    # moments earlier), so any mxu rung is staged as well.
+    staged = (rec['platform'] in TPU_PLATFORMS
+              and (Nmesh >= 512 or method == 'mxu'))
     if not staged:
         try:
             dt, compile_s = _time_fn(jax, jax.jit(fused), (pos,), reps)
@@ -538,13 +541,22 @@ def run_prim(n=10_000_000, reps=3):
 
 
 def run_paint(Nmesh, Npart, method='scatter', reps=3):
-    """Paint-only microbenchmark (the #1 perf risk, SURVEY §7)."""
+    """Paint-only microbenchmark (the #1 perf risk, SURVEY §7).
+
+    ``method`` may carry a bucketing-order suffix for the mxu kernel:
+    'mxu:radix' / 'mxu:argsort' A/B the stable-ordering engine
+    (ops/radix.py vs bitonic lax sort).
+    """
     jax = _setup_jax()
     import jax.numpy as jnp
     import nbodykit_tpu
     from nbodykit_tpu.pmesh import ParticleMesh
 
-    nbodykit_tpu.set_options(paint_method=method)
+    method_label = method      # metric key keeps the ':order' suffix
+    order = 'auto'             # no suffix -> reset (a prior suffixed
+    if ':' in method:          # call set the process-global option)
+        method, order = method.split(':', 1)
+    nbodykit_tpu.set_options(paint_method=method, paint_order=order)
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic',
@@ -552,7 +564,7 @@ def run_paint(Nmesh, Npart, method='scatter', reps=3):
     dt, _ = _time_fn(jax, fn, (pos,), reps)
     return {
         "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"
-                  % (Nmesh, Npart, method),
+                  % (Nmesh, Npart, method_label),
         "value": round(dt, 4), "unit": "s",
         "mpart_per_s": round(Npart / dt / 1e6, 1),
         "platform": jax.devices()[0].platform,

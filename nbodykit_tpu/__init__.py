@@ -46,6 +46,10 @@ _default_options = {
     'paint_method': 'scatter',
     # bucket-capacity slack for the 'mxu' paint kernel
     'paint_bucket_slack': 2.0,
+    # stable ordering engine for the mxu paint's bucketing: 'auto'
+    # (radix counting sort on TPU, bitonic argsort elsewhere),
+    # 'argsort', or 'radix' (ops/radix.py)
+    'paint_order': 'auto',
 }
 
 

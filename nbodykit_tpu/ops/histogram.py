@@ -103,13 +103,9 @@ def hist2d_bincount(abin, bbin, weights, NA, NB):
 
 
 def _default_method():
-    # the axon TPU tunnel registers its platform as 'axon', not 'tpu' —
-    # both are MXU hardware where scatter-add bincount is ~10x slower
-    try:
-        return 'mxu' if jax.default_backend() in ('tpu', 'axon') \
-            else 'bincount'
-    except Exception:
-        return 'bincount'
+    # MXU hardware: scatter-add bincount is ~10x slower there
+    from ..utils import is_mxu_backend
+    return 'mxu' if is_mxu_backend() else 'bincount'
 
 
 def hist2d_weighted(abin, bbin, weights, NA, NB, method=None,

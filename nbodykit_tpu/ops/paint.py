@@ -311,7 +311,7 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
 # ---------------------------------------------------------------------------
 # MXU paint: tile-bucketed batched-matmul deposit
 
-def _bucket_by_argsort(key, n, B, Kcap):
+def _bucket_by_argsort(key, n, B, Kcap, order_method='auto'):
     """Assign each particle a slot in a (B, Kcap) padded bucket layout.
 
     Returns ``src`` (B*Kcap,) int32 — source particle index per padded
@@ -320,10 +320,22 @@ def _bucket_by_argsort(key, n, B, Kcap):
     callers retry with a larger slack, mirroring the exchange-overflow
     contract in parallel/exchange.py).
 
-    One lax sort + one unique-indices scatter; pluggable so a counting
-    sort can replace it if hardware measurement favors one.
+    ``order_method`` picks the stable ordering engine: 'argsort' (one
+    bitonic lax sort — O(n log^2 n) HBM passes on TPU, but the fast
+    native sort on CPU), 'radix' (ops.radix.stable_key_order — O(n)
+    counting passes, the TPU-shaped choice), or 'auto' (radix on
+    MXU backends, argsort elsewhere). Both are stable, so the slot
+    assignment is IDENTICAL — tests/test_radix.py asserts it.
     """
-    order = jnp.argsort(key)
+    if order_method == 'auto':
+        from ..utils import is_mxu_backend
+        order_method = 'radix' if is_mxu_backend() else 'argsort'
+    if order_method == 'radix':
+        from .radix import stable_key_order
+        # alphabet is [0, B] (B = trash bucket)
+        order = stable_key_order(key, B + 1)
+    else:
+        order = jnp.argsort(key)
     skey = key[order]
     iot = jnp.arange(n, dtype=jnp.int32)
     is_start = jnp.concatenate(
@@ -342,7 +354,8 @@ def _bucket_by_argsort(key, n, B, Kcap):
 
 def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
                     origin=0, out=None, rb=8, cb=8, slack=2.0,
-                    return_overflow=False, zchunk_bytes=ZCHUNK_BYTES):
+                    return_overflow=False, zchunk_bytes=ZCHUNK_BYTES,
+                    order_method='auto'):
     """Scatter particles onto a local mesh block via MXU matmuls.
 
     TPU has no scatter atomics and XLA lowers scatter-add to a serial
@@ -423,7 +436,8 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
                                    resampler=resampler, period=period,
                                    origin=origin, out=out, rb=rb2,
                                    cb=cb2, slack=slack,
-                                   return_overflow=return_overflow)
+                                   return_overflow=return_overflow,
+                                   order_method=order_method)
         return _scatter_fallback()
     B = (ntx + 1) * nty
     # expected occupancy of the FULLEST tile, not the all-bucket mean:
@@ -468,7 +482,8 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
     ck = -(-ck // 8) * 8
     Kcap = npieces * ck              # pieces tile Kcap exactly
 
-    src, overflow = _bucket_by_argsort(key, n, B, Kcap)
+    src, overflow = _bucket_by_argsort(key, n, B, Kcap,
+                                       order_method=order_method)
     vsrc = src < n
     srcc = jnp.minimum(src, max(n - 1, 0))
     ppos = jnp.take(pos, srcc, axis=0)

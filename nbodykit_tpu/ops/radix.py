@@ -1,0 +1,141 @@
+"""Stable counting/radix ordering for small-alphabet keys.
+
+Several hot paths order particles by a *small* integer key — the paint
+bucketing (ops/paint.py: tile id), the exchange routing
+(parallel/exchange.py: destination device), the cell hash
+(ops/devicehash.py: grid cell). They all reached for ``jnp.argsort``,
+which XLA lowers to a bitonic network on TPU: O(n log^2 n) passes over
+HBM — the measured dominant cost of the mxu paint at 256^3 (see
+docs/PERF.md).
+
+For keys drawn from a known alphabet of D values a *stable counting
+sort* does the same job in O(n) with TPU-shaped ops only:
+
+  rank[i]  = #{j < i : key[j] == key[i]}   (chunked scan: one-hot
+             cumsum per chunk + per-digit running totals carried
+             across chunks; the one-hot trick ``(cumO * O).sum(1)``
+             reads the cumsum at each row's own digit with NO gather)
+  start[d] = exclusive cumsum of the digit histogram (final carry)
+  dest[i]  = start[key[i]] + rank[i]       (a permutation)
+
+and one unique-index scatter materializes the order (or routes the
+payload directly). For alphabets too wide for one pass (the paint's
+tile id reaches ~16k at Nmesh=1024) two LSD passes over base-R digits
+compose: stable by low digit, then stable by high digit.
+
+The reference meets the same need with mpsort's distributed C
+histogram sort (consumed at nbodykit/base/catalog.py:1285,
+nbodykit/mockmaker.py:344); this module is the single-device,
+in-graph building block of that design.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _pass_rank_hist(digit, D, chunk):
+    """rank[i] = # of j < i with digit[j] == digit[i]; hist = digit
+    histogram. One scan over chunks; exact in i32 (per-chunk one-hot
+    cumsum stays < chunk <= 2^24 in f32, cross-chunk totals are i32).
+
+    digit : (n,) int32 in [0, D) — caller pads/clamps out-of-range.
+    Returns (rank (n,) i32, hist (D,) i32).
+    """
+    n = digit.shape[0]
+    nch = max(1, -(-n // chunk))
+    Mp = nch * chunk
+    # padding digit D-1 keeps shapes static; padded ranks are sliced
+    # off and their histogram contribution subtracted
+    npad = Mp - n
+    dig_p = jnp.concatenate(
+        [digit.astype(jnp.int32),
+         jnp.full((npad,), D - 1, jnp.int32)]).reshape(nch, chunk)
+
+    def step(base, d_c):
+        O = jax.nn.one_hot(d_c, D, dtype=jnp.float32)      # (C, D)
+        cumO = jnp.cumsum(O, axis=0)
+        # one-hot picks cumO[i, d_i]: inclusive count -> exclusive
+        rank_in = (cumO * O).sum(axis=1).astype(jnp.int32) - 1
+        rank_c = jnp.take(base, d_c, axis=0) + rank_in
+        base = base + cumO[-1].astype(jnp.int32)
+        return base, rank_c
+
+    # data-derived zero init: under shard_map the scan carry must have
+    # the same varying-manual-axes type as the per-step update (same
+    # convention as ops/paint.py's scan carries)
+    base0 = jnp.zeros((D,), jnp.int32) + dig_p.ravel()[0] * 0
+    hist, ranks = jax.lax.scan(step, base0, dig_p)
+    ranks = ranks.reshape(Mp)[:n]
+    hist = hist.at[D - 1].add(-npad)
+    return ranks, hist
+
+
+# rank-pass engine: 'xla' (the scan above), 'pallas' (VMEM kernel,
+# ops/radix_pallas.py — ~D columns less HBM traffic per element), or
+# 'auto'. Module-level default so hardware A/B (bench.py --prim) can
+# flip it. 'auto' currently resolves to 'xla' EVERYWHERE: Mosaic/
+# Pallas custom calls are unproven over the axon remote-compile
+# tunnel, and an exchange that crashed at compile time on the bench
+# host would take the whole multi-device paint path with it. Flip to
+# pallas-on-TPU only after bench.py measures the kernel on hardware.
+DEFAULT_ENGINE = 'auto'
+
+
+def _rank_hist(digit, D, chunk, engine=None):
+    engine = engine or DEFAULT_ENGINE
+    if engine == 'auto':
+        engine = 'xla'
+    if engine == 'pallas':
+        from .radix_pallas import pass_rank_hist_pallas
+        return pass_rank_hist_pallas(digit, D, chunk=max(chunk, 1024))
+    return _pass_rank_hist(digit, D, chunk)
+
+
+def stable_digit_dest(digit, D, chunk=4096, engine=None):
+    """dest[i] = stable-counting-sort position of element i; a
+    permutation of [0, n)."""
+    rank, hist = _rank_hist(digit, D, chunk, engine)
+    start = jnp.cumsum(hist) - hist           # exclusive
+    return jnp.take(start, digit.astype(jnp.int32), axis=0) + rank
+
+
+def _invert_perm(dest):
+    """order[dest[i]] = i (scatter with provably unique indices)."""
+    n = dest.shape[0]
+    iot = jnp.arange(n, dtype=jnp.int32)
+    return jnp.zeros((n,), jnp.int32).at[dest].set(
+        iot, unique_indices=True)
+
+
+def stable_key_order(key, D, chunk=4096, radix=None, engine=None):
+    """Permutation ``order`` with ``key[order]`` stably sorted.
+
+    Drop-in for ``jnp.argsort(key)`` when keys are known to lie in
+    [0, D) (out-of-range keys must be clamped to D-1 by the caller —
+    the bucketing call sites already route invalid slots to a trash
+    value). One counting pass when D <= ``radix`` threshold, else two
+    LSD passes over base-R digits with R = ceil(sqrt(D)).
+
+    chunk : scan chunk size; per-chunk one-hot is (chunk, R) f32.
+    """
+    n = key.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.int32)
+    key = key.astype(jnp.int32)
+    if radix is None:
+        radix = 1024
+    if D <= radix:
+        order = _invert_perm(stable_digit_dest(key, D, chunk, engine))
+        return order
+    R = int(np.ceil(np.sqrt(D)))
+    Rhi = -(-D // R)
+    # pass 1: low digit
+    dest1 = stable_digit_dest(key % R, R, chunk, engine)
+    order1 = _invert_perm(dest1)
+    # pass 2: high digit of the pass-1-ordered keys (stable => the low
+    # digit's order survives within each high-digit class)
+    khi = jnp.take(key, order1, axis=0) // R
+    dest2 = stable_digit_dest(khi, Rhi, chunk, engine)
+    order2 = _invert_perm(dest2)
+    return jnp.take(order1, order2, axis=0)

@@ -114,22 +114,41 @@ def _bucket_local(dest, arrays, nproc, capacity, fill=0.0, live=None):
     (nproc, capacity, ...); valid is (nproc, capacity) bool.
     """
     n = dest.shape[0]
-    order = jnp.argsort(dest)
-    dest_s = dest[order]
-    # rank of each particle within its destination bucket
-    idx = jnp.arange(n, dtype=jnp.int32)
-    start = jnp.searchsorted(dest_s, jnp.arange(nproc, dtype=dest_s.dtype),
-                             side='left')
-    rank_in_bucket = idx - start[dest_s]
+    from ..utils import is_mxu_backend
+    if is_mxu_backend():
+        # TPU path: the destination alphabet is tiny (nproc values), so
+        # the per-particle rank within its destination bucket comes
+        # straight from the radix counting pass (ops/radix.py) — the
+        # slot assignment needs NO sort, no searchsorted, and no
+        # permutation of the payloads: (dest, rank) pairs are unique by
+        # construction, so the buffer scatter is collision-free. Same
+        # layout as the argsort path below (both stable).
+        from ..ops.radix import _rank_hist
+        dest_key = jnp.clip(jnp.asarray(dest, jnp.int32), 0, nproc - 1)
+        rank_in_bucket, _ = _rank_hist(dest_key, nproc, 4096)
+        live_a = live
+        srcs = arrays
+    else:
+        order = jnp.argsort(dest)
+        dest_key = dest[order]
+        # rank of each particle within its destination bucket
+        idx = jnp.arange(n, dtype=jnp.int32)
+        start = jnp.searchsorted(dest_key,
+                                 jnp.arange(nproc, dtype=dest_key.dtype),
+                                 side='left')
+        rank_in_bucket = idx - start[dest_key]
+        live_a = None if live is None else live[order]
+        srcs = [a[order] for a in arrays]
+    # shared capacity/overflow accounting (branch-independent)
     ok = rank_in_bucket < capacity
-    lost = ~ok if live is None else (~ok & live[order])
+    lost = ~ok if live_a is None else (~ok & live_a)
     dropped = jnp.sum(lost)
-    slot = jnp.where(ok, dest_s * capacity + rank_in_bucket, nproc * capacity)
+    slot = jnp.where(ok, dest_key * capacity + rank_in_bucket,
+                     nproc * capacity)
     valid = jnp.zeros((nproc * capacity + 1,), dtype=bool).at[slot].set(True)
     valid = valid[:-1].reshape(nproc, capacity)
     out = []
-    for a in arrays:
-        a_s = a[order]
+    for a_s, a in zip(srcs, arrays):
         buf_shape = (nproc * capacity + 1,) + a.shape[1:]
         buf = jnp.full(buf_shape, fill, dtype=a.dtype).at[slot].set(a_s)
         out.append(buf[:-1].reshape((nproc, capacity) + a.shape[1:]))

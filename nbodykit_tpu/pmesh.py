@@ -303,9 +303,12 @@ class ParticleMesh(object):
                     return (paint_local_sorted(*a, **kw),
                             jnp.zeros((), jnp.int32))
             elif pm_method == 'mxu':
+                order = _global_options['paint_order']
+
                 def kern(*a, **kw):
                     return paint_local_mxu(*a, slack=mxu_slack,
-                                           return_overflow=True, **kw)
+                                           return_overflow=True,
+                                           order_method=order, **kw)
             else:
                 def kern(*a, **kw):
                     return (paint_local(*a, chunk=chunk, **kw),

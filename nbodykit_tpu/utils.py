@@ -12,6 +12,17 @@ import numpy as np
 import jax
 
 
+def is_mxu_backend():
+    """True on MXU hardware (TPU, incl. the axon tunnel's platform
+    name) — the shared dispatch predicate for kernels with a
+    TPU-shaped and a CPU-shaped implementation (histogram, paint
+    bucketing, radix ordering, exchange routing)."""
+    try:
+        return jax.default_backend() in ('tpu', 'axon')
+    except Exception:
+        return False
+
+
 def working_dtype(dt='f8'):
     """The widest available dtype no wider than ``dt``: the 64-bit
     float/complex/int types when x64 is enabled, else their 32-bit
