@@ -317,7 +317,11 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     import nbodykit_tpu
     from nbodykit_tpu.pmesh import ParticleMesh
 
-    nbodykit_tpu.set_options(paint_method=method)
+    # reset the engine options too: a prior suffixed run_paint in this
+    # process must not leak non-default engines into a rung labeled
+    # only by paint_method
+    nbodykit_tpu.set_options(paint_method=method, paint_order='auto',
+                             paint_deposit='auto')
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fused, phase_fns = _bench_fftpower_fn(pm)
@@ -536,6 +540,26 @@ def run_prim(n=10_000_000, reps=3):
       idx, vals)
     t('argsort_small_key', lambda k: jnp.argsort(k), small)
     t('cumsum', lambda v: jnp.cumsum(v), vals)
+
+    # the counting-sort path (ops/radix.py): per-pass rank scan and the
+    # full stable order, at the paint's two alphabet scales; plus the
+    # same through the Pallas VMEM kernel — the first probe of whether
+    # Mosaic custom calls lower over the axon tunnel at all
+    from nbodykit_tpu.ops.radix import (stable_key_order,
+                                        _pass_rank_hist)
+    t('radix_rank_xla_D130', lambda k: _pass_rank_hist(k % 130, 130,
+                                                       4096)[0], small)
+    t('radix_order_D130', lambda k: stable_key_order(k % 130, 130,
+                                                     engine='xla'),
+      small)
+    t('radix_order_D16513',
+      lambda k: stable_key_order(k % 16513, 16513, engine='xla'), idx)
+    try:
+        from nbodykit_tpu.ops.radix_pallas import pass_rank_hist_pallas
+        t('radix_rank_pallas_D130',
+          lambda k: pass_rank_hist_pallas(k % 130, 130)[0], small)
+    except Exception as e:          # lowering/import failure is itself
+        out['radix_rank_pallas_D130'] = {"error": str(e)[:200]}  # data
     return {"metric": "prim_microbench_n%.0e" % n, "n": n,
             "platform": jax.devices()[0].platform, "prims": out}
 
@@ -543,20 +567,26 @@ def run_prim(n=10_000_000, reps=3):
 def run_paint(Nmesh, Npart, method='scatter', reps=3):
     """Paint-only microbenchmark (the #1 perf risk, SURVEY §7).
 
-    ``method`` may carry a bucketing-order suffix for the mxu kernel:
-    'mxu:radix' / 'mxu:argsort' A/B the stable-ordering engine
-    (ops/radix.py vs bitonic lax sort).
+    ``method`` may carry engine suffixes for the mxu kernel:
+    'mxu:ORDER[:DEPOSIT]' with ORDER in {radix, argsort, auto} and
+    DEPOSIT in {xla, pallas, auto} — A/B of the bucketing order
+    (ops/radix.py vs bitonic lax sort) and the deposit engine (XLA
+    one-hot expansions vs the fused Pallas VMEM kernel).
     """
     jax = _setup_jax()
     import jax.numpy as jnp
     import nbodykit_tpu
     from nbodykit_tpu.pmesh import ParticleMesh
 
-    method_label = method      # metric key keeps the ':order' suffix
-    order = 'auto'             # no suffix -> reset (a prior suffixed
-    if ':' in method:          # call set the process-global option)
-        method, order = method.split(':', 1)
-    nbodykit_tpu.set_options(paint_method=method, paint_order=order)
+    method_label = method      # metric key keeps the suffixes
+    order = dep = 'auto'       # no suffix -> reset (a prior suffixed
+    if ':' in method:          # call set the process-global options)
+        parts = method.split(':')
+        method, order = parts[0], parts[1]
+        if len(parts) > 2:
+            dep = parts[2]
+    nbodykit_tpu.set_options(paint_method=method, paint_order=order,
+                             paint_deposit=dep)
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic',

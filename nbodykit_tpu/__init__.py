@@ -50,6 +50,10 @@ _default_options = {
     # (radix counting sort on TPU, bitonic argsort elsewhere),
     # 'argsort', or 'radix' (ops/radix.py)
     'paint_order': 'auto',
+    # deposit engine for the mxu paint: 'auto'/'xla' (one-hot
+    # expansions via XLA) or 'pallas' (fused VMEM kernel,
+    # ops/paint_pallas.py)
+    'paint_deposit': 'auto',
 }
 
 

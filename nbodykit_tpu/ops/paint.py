@@ -334,8 +334,12 @@ def _bucket_by_argsort(key, n, B, Kcap, order_method='auto'):
         from .radix import stable_key_order
         # alphabet is [0, B] (B = trash bucket)
         order = stable_key_order(key, B + 1)
-    else:
+    elif order_method == 'argsort':
         order = jnp.argsort(key)
+    else:
+        # a typo must not silently measure/record the wrong engine
+        raise ValueError("unknown order_method %r (choose "
+                         "'auto'/'radix'/'argsort')" % (order_method,))
     skey = key[order]
     iot = jnp.arange(n, dtype=jnp.int32)
     is_start = jnp.concatenate(
@@ -355,7 +359,7 @@ def _bucket_by_argsort(key, n, B, Kcap, order_method='auto'):
 def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
                     origin=0, out=None, rb=8, cb=8, slack=2.0,
                     return_overflow=False, zchunk_bytes=ZCHUNK_BYTES,
-                    order_method='auto'):
+                    order_method='auto', deposit='auto'):
     """Scatter particles onto a local mesh block via MXU matmuls.
 
     TPU has no scatter atomics and XLA lowers scatter-add to a serial
@@ -391,7 +395,17 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
     slack : bucket capacity = slack * mean occupancy. Overflowing
         particles are DROPPED (count returned with
         ``return_overflow=True``); callers retry with doubled slack.
+    deposit : 'xla' (one-hot expansions materialized by XLA),
+        'pallas' (fused VMEM kernel, ops/paint_pallas.py — interpreted
+        off-TPU), or 'auto' (currently 'xla' everywhere until the
+        Pallas kernel is proven over the axon tunnel; see
+        ops/radix.py DEFAULT_ENGINE for the same gating).
     """
+    if deposit == 'auto':
+        deposit = 'xla'
+    if deposit not in ('xla', 'pallas'):
+        raise ValueError("unknown deposit %r (choose "
+                         "'auto'/'xla'/'pallas')" % (deposit,))
     n0l, N1, N2 = (int(x) for x in shape)
     if period is None:
         period = shape
@@ -437,7 +451,8 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
                                    origin=origin, out=out, rb=rb2,
                                    cb=cb2, slack=slack,
                                    return_overflow=return_overflow,
-                                   order_method=order_method)
+                                   order_method=order_method,
+                                   deposit=deposit)
         return _scatter_fallback()
     B = (ntx + 1) * nty
     # expected occupancy of the FULLEST tile, not the all-bucket mean:
@@ -528,21 +543,31 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
     def stripe(carry, xs):
         mesh_pad, txi = carry
         spos, smass = xs                  # (nty, npieces, ck, [3])
-        spos_p = spos.transpose(1, 0, 2, 3)    # piece-major
-        smass_p = smass.transpose(1, 0, 2)
+        if deposit == 'pallas':
+            from .paint_pallas import deposit_blocks_pallas
+            from ..utils import is_mxu_backend
+            blocks = deposit_blocks_pallas(
+                txi, spos[..., 0], spos[..., 1], spos[..., 2], smass,
+                resampler=resampler, rb=rb, cb=cb, n0l=n0l, p0=p0,
+                N1=N1, N2=N2, origin=origin, dtype=dtype,
+                interpret=not is_mxu_backend())
+        else:
+            spos_p = spos.transpose(1, 0, 2, 3)    # piece-major
+            smass_p = smass.transpose(1, 0, 2)
 
-        def body(j, blocks):
-            return blocks + piece(
-                txi,
-                jax.lax.dynamic_index_in_dim(
-                    spos_p, j, keepdims=False).reshape(KX, 3),
-                jax.lax.dynamic_index_in_dim(
-                    smass_p, j, keepdims=False).reshape(KX))
+            def body(j, blocks):
+                return blocks + piece(
+                    txi,
+                    jax.lax.dynamic_index_in_dim(
+                        spos_p, j, keepdims=False).reshape(KX, 3),
+                    jax.lax.dynamic_index_in_dim(
+                        smass_p, j, keepdims=False).reshape(KX))
 
-        # data-derived zero init (shard_map varying-manual-axes, as
-        # for the scan carry below)
-        blocks0 = jnp.zeros((nty, M, N2), dtype) + smass.ravel()[0] * 0
-        blocks = jax.lax.fori_loop(0, npieces, body, blocks0)
+            # data-derived zero init (shard_map varying-manual-axes,
+            # as for the scan carry below)
+            blocks0 = jnp.zeros((nty, M, N2), dtype) \
+                + smass.ravel()[0] * 0
+            blocks = jax.lax.fori_loop(0, npieces, body, blocks0)
         # fold the y tiles into a (rbh, P1, N2) slab: interior cols by
         # reshape, halo cols by a cb-shifted dense add
         blocks = blocks.reshape(nty, rbh, cbh, N2).transpose(1, 0, 2, 3)

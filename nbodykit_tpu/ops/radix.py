@@ -34,6 +34,21 @@ import jax
 import jax.numpy as jnp
 
 
+def pad_digits(digit, D, chunk):
+    """Pad a digit stream to a chunk multiple with sentinel digit D-1
+    (shapes stay static). The CONTRACT shared by the XLA and Pallas
+    rank passes: padded ranks are sliced off by the caller and the
+    sentinel's histogram count must be corrected by ``hist[D-1] -=
+    npad``. Returns (padded (nch, chunk) i32, npad)."""
+    n = digit.shape[0]
+    nch = max(1, -(-n // chunk))
+    npad = nch * chunk - n
+    dig_p = jnp.concatenate(
+        [digit.astype(jnp.int32),
+         jnp.full((npad,), D - 1, jnp.int32)]).reshape(nch, chunk)
+    return dig_p, npad
+
+
 def _pass_rank_hist(digit, D, chunk):
     """rank[i] = # of j < i with digit[j] == digit[i]; hist = digit
     histogram. One scan over chunks; exact in i32 (per-chunk one-hot
@@ -43,14 +58,8 @@ def _pass_rank_hist(digit, D, chunk):
     Returns (rank (n,) i32, hist (D,) i32).
     """
     n = digit.shape[0]
-    nch = max(1, -(-n // chunk))
-    Mp = nch * chunk
-    # padding digit D-1 keeps shapes static; padded ranks are sliced
-    # off and their histogram contribution subtracted
-    npad = Mp - n
-    dig_p = jnp.concatenate(
-        [digit.astype(jnp.int32),
-         jnp.full((npad,), D - 1, jnp.int32)]).reshape(nch, chunk)
+    dig_p, npad = pad_digits(digit, D, chunk)
+    Mp = dig_p.size
 
     def step(base, d_c):
         O = jax.nn.one_hot(d_c, D, dtype=jnp.float32)      # (C, D)

@@ -64,14 +64,13 @@ def pass_rank_hist_pallas(digit, D, chunk=2048, interpret=False):
     digit : (n,) int32 in [0, D).
     Returns (rank (n,) i32, hist (D,) i32).
     """
+    from .radix import pad_digits
+
     n = digit.shape[0]
     C = int(min(chunk, max(256, n)))
-    nch = max(1, -(-n // C))
-    Mp = nch * C
-    npad = Mp - n
-    dig_p = jnp.concatenate(
-        [digit.astype(jnp.int32),
-         jnp.full((npad,), D - 1, jnp.int32)]).reshape(nch, C)
+    dig_p, npad = pad_digits(digit, D, C)
+    nch = dig_p.shape[0]
+    Mp = dig_p.size
 
     kern = functools.partial(_rank_kernel, D=D, C=C)
     rank_p, hist = pl.pallas_call(

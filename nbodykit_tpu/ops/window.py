@@ -30,6 +30,29 @@ def window_support(resampler):
                          % (resampler, sorted(RESAMPLERS)))
 
 
+def window_base(x, resampler):
+    """Index of the FIRST neighbor cell (offset a=0) of the window at
+    cell coordinate ``x``; the full stencil is base + [0, s)."""
+    s = window_support(resampler)
+    if s % 2 == 0:
+        return jnp.floor(x).astype(jnp.int32) - (s // 2 - 1)
+    return jnp.floor(x + 0.5).astype(jnp.int32) - (s - 1) // 2
+
+
+def bspline(d, s):
+    """B-spline window value at |distance| ``d`` (cell units) for
+    support ``s`` (see module docstring table)."""
+    if s == 1:
+        return jnp.ones_like(d)
+    if s == 2:
+        return jnp.maximum(1.0 - d, 0.0)
+    if s == 3:
+        return jnp.where(d <= 0.5, 0.75 - d * d,
+                         0.5 * jnp.square(jnp.maximum(1.5 - d, 0.0)))
+    return jnp.where(d <= 1.0, (4.0 - 6.0 * d * d + 3.0 * d ** 3) / 6.0,
+                     jnp.maximum(2.0 - d, 0.0) ** 3 / 6.0)
+
+
 def window_weights(x, resampler):
     """Per-axis neighbor indices and weights for particles at cell
     coordinate ``x`` (float, cell units).
@@ -45,24 +68,11 @@ def window_weights(x, resampler):
     w : (..., s) float — window weights, sum to 1 along the last axis
     """
     s = window_support(resampler)
-    if s % 2 == 0:
-        base = jnp.floor(x).astype(jnp.int32) - (s // 2 - 1)
-    else:
-        base = jnp.floor(x + 0.5).astype(jnp.int32) - (s - 1) // 2
+    base = window_base(x, resampler)
     offs = jnp.arange(s, dtype=jnp.int32)
     idx = base[..., None] + offs
     d = jnp.abs(x[..., None] - idx.astype(x.dtype))
-    if s == 1:
-        w = jnp.ones_like(d)
-    elif s == 2:
-        w = jnp.maximum(1.0 - d, 0.0)
-    elif s == 3:
-        w = jnp.where(d <= 0.5, 0.75 - d * d,
-                      0.5 * jnp.square(jnp.maximum(1.5 - d, 0.0)))
-    elif s == 4:
-        w = jnp.where(d <= 1.0, (4.0 - 6.0 * d * d + 3.0 * d ** 3) / 6.0,
-                      jnp.maximum(2.0 - d, 0.0) ** 3 / 6.0)
-    return idx, w
+    return idx, bspline(d, s)
 
 
 def _sinc(x):

@@ -304,11 +304,13 @@ class ParticleMesh(object):
                             jnp.zeros((), jnp.int32))
             elif pm_method == 'mxu':
                 order = _global_options['paint_order']
+                dep = _global_options['paint_deposit']
 
                 def kern(*a, **kw):
                     return paint_local_mxu(*a, slack=mxu_slack,
                                            return_overflow=True,
-                                           order_method=order, **kw)
+                                           order_method=order,
+                                           deposit=dep, **kw)
             else:
                 def kern(*a, **kw):
                     return (paint_local(*a, chunk=chunk, **kw),
