@@ -81,7 +81,13 @@ class DeviceGridHash(object):
         # dead slots go to a sentinel id no query can produce
         flat = jnp.where(valid, flat,
                          jnp.asarray(self.ncells_tot, self._idt))
-        order = jnp.argsort(flat)
+        if self._idt is jnp.int32:
+            # cell-id alphabet is known: the stable counting order
+            # replaces the bitonic argsort on TPU (ops/radix.py)
+            from .radix import stable_order
+            order = stable_order(flat, int(self.ncells_tot) + 1)
+        else:
+            order = jnp.argsort(flat)
         self.flat_s = flat[order]
         self.order = order
         self.pos_s = pos[order]

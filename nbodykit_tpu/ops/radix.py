@@ -20,8 +20,8 @@ sort* does the same job in O(n) with TPU-shaped ops only:
 
 and one unique-index scatter materializes the order (or routes the
 payload directly). For alphabets too wide for one pass (the paint's
-tile id reaches ~16k at Nmesh=1024) two LSD passes over base-R digits
-compose: stable by low digit, then stable by high digit.
+tile id reaches ~16k at Nmesh=1024; hash-grid cell ids reach 1e6+),
+k stable LSD passes over balanced base-ceil(D^(1/k)) digits compose.
 
 The reference meets the same need with mpsort's distributed C
 histogram sort (consumed at nbodykit/base/catalog.py:1285,
@@ -109,6 +109,17 @@ def stable_digit_dest(digit, D, chunk=4096, engine=None):
     return jnp.take(start, digit.astype(jnp.int32), axis=0) + rank
 
 
+def stable_order(key, D):
+    """Backend-dispatched stable ordering: the counting sort on MXU
+    hardware, native argsort elsewhere — the ONE policy point for the
+    argsort-replacement call sites (devicehash, dist_sort; paint
+    routes through its order_method option instead)."""
+    from ..utils import is_mxu_backend
+    if is_mxu_backend():
+        return stable_key_order(key, D)
+    return jnp.argsort(key)
+
+
 def _invert_perm(dest):
     """order[dest[i]] = i (scatter with provably unique indices)."""
     n = dest.shape[0]
@@ -123,8 +134,9 @@ def stable_key_order(key, D, chunk=4096, radix=None, engine=None):
     Drop-in for ``jnp.argsort(key)`` when keys are known to lie in
     [0, D) (out-of-range keys must be clamped to D-1 by the caller —
     the bucketing call sites already route invalid slots to a trash
-    value). One counting pass when D <= ``radix`` threshold, else two
-    LSD passes over base-R digits with R = ceil(sqrt(D)).
+    value). One counting pass when D <= ``radix`` threshold, else
+    k = ceil(log_radix(D)) LSD passes over balanced base-ceil(D^(1/k))
+    digits.
 
     chunk : scan chunk size; per-chunk one-hot is (chunk, R) f32.
     """
@@ -135,16 +147,17 @@ def stable_key_order(key, D, chunk=4096, radix=None, engine=None):
     if radix is None:
         radix = 1024
     if D <= radix:
-        order = _invert_perm(stable_digit_dest(key, D, chunk, engine))
-        return order
-    R = int(np.ceil(np.sqrt(D)))
-    Rhi = -(-D // R)
-    # pass 1: low digit
-    dest1 = stable_digit_dest(key % R, R, chunk, engine)
-    order1 = _invert_perm(dest1)
-    # pass 2: high digit of the pass-1-ordered keys (stable => the low
-    # digit's order survives within each high-digit class)
-    khi = jnp.take(key, order1, axis=0) // R
-    dest2 = stable_digit_dest(khi, Rhi, chunk, engine)
-    order2 = _invert_perm(dest2)
-    return jnp.take(order1, order2, axis=0)
+        return _invert_perm(stable_digit_dest(key, D, chunk, engine))
+    # k LSD passes over balanced base-R digits, R = ceil(D^(1/k)):
+    # stable passes low-digit-first compose into the full order
+    npasses = int(np.ceil(np.log(D) / np.log(radix)))
+    R = int(np.ceil(D ** (1.0 / npasses)))
+    order = None
+    f = 1
+    for _ in range(npasses):
+        k_cur = key if order is None else jnp.take(key, order, axis=0)
+        dig = (k_cur // f) % R
+        step = _invert_perm(stable_digit_dest(dig, R, chunk, engine))
+        order = step if order is None else jnp.take(order, step, axis=0)
+        f *= R
+    return order

@@ -159,8 +159,11 @@ def dist_sort(keys, values=None, mesh=None, slack=2.0):
         # overflow among them is harmless padding)
         dest2 = jnp.where(valid, dest2, nproc - 1)
         # order by dest2 is already monotone for valid entries; put
-        # invalid at the end so ranks stay contiguous
-        reorder = jnp.argsort(jnp.where(valid, dest2, nproc))
+        # invalid at the end so ranks stay contiguous (tiny alphabet:
+        # counting order on TPU, argsort elsewhere)
+        from ..ops.radix import stable_order
+        reorder = stable_order(jnp.where(valid, dest2, nproc),
+                               nproc + 1)
         ks3 = ks2[reorder]
         vs3 = [v[reorder] for v in vs2]
         dest3 = dest2[reorder]

@@ -11,7 +11,8 @@ from nbodykit_tpu.ops.radix import (stable_key_order, stable_digit_dest,
 
 @pytest.mark.parametrize("n,D", [(1, 1), (17, 3), (1000, 7),
                                  (4096, 130), (5000, 130),
-                                 (3000, 2000), (8191, 16513)])
+                                 (3000, 2000), (8191, 16513),
+                                 (4000, 2_000_003)])
 def test_matches_stable_argsort(n, D):
     rng = np.random.RandomState(n + D)
     key = rng.randint(0, D, n).astype(np.int32)
@@ -111,6 +112,26 @@ def test_bucket_local_radix_matches_argsort(monkeypatch):
         else:
             drop0 = int(dropped)
     assert drop0 > 0
+
+
+def test_devicehash_radix_order_matches(monkeypatch):
+    """DeviceGridHash built with the counting order must equal the
+    argsort-built one (both stable)."""
+    import nbodykit_tpu.utils as utils
+    from nbodykit_tpu.ops.devicehash import DeviceGridHash
+
+    rng = np.random.RandomState(9)
+    pos = jnp.asarray(rng.uniform(0, 100.0, (3000, 3)).astype('f4'))
+    valid = jnp.asarray(rng.rand(3000) > 0.05)
+    hashes = {}
+    for forced in (False, True):
+        monkeypatch.setattr(utils, 'is_mxu_backend', lambda f=forced: f)
+        h = DeviceGridHash(pos, box=100.0, rmax=8.0, valid=valid)
+        hashes[forced] = h
+    np.testing.assert_array_equal(np.asarray(hashes[0].order),
+                                  np.asarray(hashes[1].order))
+    np.testing.assert_array_equal(np.asarray(hashes[0].flat_s),
+                                  np.asarray(hashes[1].flat_s))
 
 
 @pytest.mark.parametrize("n,D", [(1000, 7), (5000, 130), (4096, 512)])
