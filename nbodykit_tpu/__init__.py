@@ -54,6 +54,11 @@ _default_options = {
     # expansions via XLA) or 'pallas' (fused VMEM kernel,
     # ops/paint_pallas.py)
     'paint_deposit': 'auto',
+    # single-device FFTs whose complex output exceeds this many bytes
+    # run as slab-chunked per-axis passes (a single FFT op over a
+    # multi-GB buffer exceeds TPU compiler limits; see parallel/dfft).
+    # 0 disables chunking.
+    'fft_chunk_bytes': 2 ** 31,
 }
 
 
@@ -132,6 +137,9 @@ class set_options(object):
         'scatter', 'sort' or 'mxu' — the local deposit kernel.
     paint_bucket_slack : float
         bucket-capacity slack factor for the 'mxu' paint kernel.
+    fft_chunk_bytes : int
+        single-device FFTs with complex output larger than this run as
+        slab-chunked per-axis passes (0 disables).
     """
 
     def __init__(self, **kwargs):

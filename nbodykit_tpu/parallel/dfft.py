@@ -30,11 +30,190 @@ the double-count weights for the missing half-plane are handled at binning
 time (see meshtools.py, mirroring reference nbodykit/meshtools.py:188-215).
 """
 
+from functools import lru_cache as _lru_cache
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .runtime import AXIS, mesh_size
+
+
+def _fft_chunk_bytes():
+    from .. import _global_options
+    return int(_global_options['fft_chunk_bytes'])
+
+
+def _chunk_rows(n, bytes_per_row, target):
+    """Largest divisor of ``n`` whose slab stays under ``target`` bytes."""
+    r = max(1, min(n, int(target // max(bytes_per_row, 1))))
+    while n % r:
+        r -= 1
+    return r
+
+
+def rfftn_single_lowmem(x_box, norm=None, target=None):
+    """Eager single-device 3-D rFFT that peaks at ~2 full-mesh buffers.
+
+    The in-jit chunked transform (:func:`_rfftn_single_chunked`) keeps
+    every FFT op small, but XLA double-buffers the ``fori_loop`` carry,
+    so the whole program still holds ~4 full-mesh buffers — over a
+    single chip's HBM for a 1024-cube next to the painted field.  Here
+    the chunk loop runs in *Python* and each chunk call donates the
+    accumulator, which XLA aliases in-place across call boundaries
+    (guaranteed for same-shape/dtype donation, unlike a loop carry).
+
+    ``x_box`` is a single-element list holding the real field; the
+    list is emptied (ownership transfer) so the input buffer can be
+    freed as soon as the first pass is done — the caller must not keep
+    another reference.  Returns the transposed (N1, N0, Nc) layout of
+    :func:`dist_rfftn`.  Not traceable: call outside jit.
+    """
+    if isinstance(x_box, (list,)):
+        x = x_box.pop()
+    else:
+        x = x_box
+    if target is None:
+        target = _fft_chunk_bytes() or 2 ** 31
+    progs = _lowmem_programs(x.shape, str(x.dtype), norm, int(target))
+    r0, r1, zeros_y, zeros_out, slab_a, upd_a, slab_b, upd_b = progs
+    N0, N1, _ = x.shape
+
+    # pass A: rfft along z + fft along y, slab-chunked over x rows;
+    # y is donated through every chunk call -> updated in place
+    y = zeros_y()
+    for i in range(N0 // r0):
+        idx = jnp.int32(i * r0)
+        y = upd_a(y, slab_a(x, idx), idx)
+    del x  # input freed before pass B allocates its output
+
+    # pass B: fft along x, chunked over y columns, written transposed
+    out = zeros_out()
+    for j in range(N1 // r1):
+        jdx = jnp.int32(j * r1)
+        out = upd_b(out, slab_b(y, jdx), jdx)
+    return out
+
+
+@_lru_cache(maxsize=16)
+def _lowmem_programs(shape, dtype_str, norm, target):
+    """Jitted stage programs for :func:`rfftn_single_lowmem`, cached per
+    (shape, dtype, norm, target) so repeated transforms re-use the
+    compiled executables instead of re-tracing every call.
+
+    Every step is a jitted program — eager ops on multi-GB operands are
+    not supported by every backend (axon raises UNIMPLEMENTED) — and
+    slice starts are traced so each program compiles exactly once.
+    """
+    N0, N1, N2 = shape
+    Nc = N2 // 2 + 1
+    itemsize = jnp.dtype(dtype_str).itemsize
+    cdt = jnp.complex64 if itemsize <= 4 else jnp.complex128
+    csz = jnp.dtype(cdt).itemsize
+    op_target = max(target // 4, 1)
+    r0 = _chunk_rows(N0, N1 * Nc * csz, op_target)
+    r1 = _chunk_rows(N1, N0 * Nc * csz, op_target)
+
+    def _upd(dst, s, i):
+        z = jnp.zeros((), i.dtype)
+        return jax.lax.dynamic_update_slice(dst, s, (i, z, z))
+
+    @jax.jit
+    def slab_a(x, i):
+        z = jnp.zeros((), i.dtype)
+        xc = jax.lax.dynamic_slice(x, (i, z, z), (r0, N1, N2))
+        return jnp.fft.fft(jnp.fft.rfft(xc, axis=2, norm=norm),
+                           axis=1, norm=norm).astype(cdt)
+
+    @jax.jit
+    def slab_b(y, j):
+        z = jnp.zeros((), j.dtype)
+        yc = jax.lax.dynamic_slice(y, (z, j, z), (N0, r1, Nc))
+        return jnp.transpose(jnp.fft.fft(yc, axis=0, norm=norm),
+                             (1, 0, 2))
+
+    zeros_y = jax.jit(lambda: jnp.zeros((N0, N1, Nc), cdt))
+    zeros_out = jax.jit(lambda: jnp.zeros((N1, N0, Nc), cdt))
+    return (r0, r1, zeros_y, zeros_out, slab_a,
+            jax.jit(_upd, donate_argnums=(0,)), slab_b,
+            jax.jit(_upd, donate_argnums=(0,)))
+
+
+def _rfftn_single_chunked(x, norm, target):
+    """Single-device 3-D rFFT as three slab-chunked 1-D passes.
+
+    A single FFT op over a multi-GB buffer can exceed the TPU
+    compiler's limits (the axon remote-compile helper dies on a
+    full-array rfft of a >=4 GB field while per-slab ops of the same
+    total size compile and run fine), so beyond
+    ``set_options(fft_chunk_bytes=...)`` the transform runs per axis
+    over slabs of ~target/4 bytes inside ``fori_loop``.  At these sizes
+    the FFT is HBM-bound either way; the extra pass over the array is
+    the only cost.  Returns the transposed (N1, N0, Nc) layout like the
+    multi-device path.
+    """
+    N0, N1, N2 = x.shape
+    Nc = N2 // 2 + 1
+    cdt = jnp.complex64 if x.dtype.itemsize <= 4 else jnp.complex128
+    csz = jnp.dtype(cdt).itemsize
+    op_target = max(target // 4, 1)
+
+    # pass A: rfft along z + fft along y, slab-chunked over x
+    r0 = _chunk_rows(N0, N1 * Nc * csz, op_target)
+    y = jnp.zeros((N0, N1, Nc), cdt)
+
+    def body_a(i, y):
+        sl = jax.lax.dynamic_slice(x, (i * r0, 0, 0), (r0, N1, N2))
+        s = jnp.fft.fft(jnp.fft.rfft(sl, axis=2, norm=norm),
+                        axis=1, norm=norm).astype(cdt)
+        return jax.lax.dynamic_update_slice(y, s, (i * r0, 0, 0))
+
+    y = jax.lax.fori_loop(0, N0 // r0, body_a, y)
+
+    # pass B: fft along x, chunked over y, written transposed
+    r1 = _chunk_rows(N1, N0 * Nc * csz, op_target)
+    out = jnp.zeros((N1, N0, Nc), cdt)
+
+    def body_b(j, out):
+        sl = jax.lax.dynamic_slice(y, (0, j * r1, 0), (N0, r1, Nc))
+        s = jnp.transpose(jnp.fft.fft(sl, axis=0, norm=norm), (1, 0, 2))
+        return jax.lax.dynamic_update_slice(out, s, (j * r1, 0, 0))
+
+    return jax.lax.fori_loop(0, N1 // r1, body_b, out)
+
+
+def _irfftn_single_chunked(y, Nmesh2, norm, target):
+    """Inverse of :func:`_rfftn_single_chunked` (same chunking rationale)."""
+    N1, N0, Nc = y.shape
+    csz = jnp.dtype(y.dtype).itemsize
+    rdt = jnp.float32 if csz <= 8 else jnp.float64
+    op_target = max(target // 4, 1)
+
+    # pass A: undo the x-axis fft (axis 1 of the transposed layout),
+    # chunked over ky rows, written back in (x, ky, kz) order
+    r1 = _chunk_rows(N1, N0 * Nc * csz, op_target)
+    z = jnp.zeros((N0, N1, Nc), y.dtype)
+
+    def body_a(j, z):
+        sl = jax.lax.dynamic_slice(y, (j * r1, 0, 0), (r1, N0, Nc))
+        s = jnp.transpose(jnp.fft.ifft(sl, axis=1, norm=norm), (1, 0, 2))
+        return jax.lax.dynamic_update_slice(z, s, (0, j * r1, 0))
+
+    z = jax.lax.fori_loop(0, N1 // r1, body_a, z)
+
+    # pass B: ifft along y + irfft along z, chunked over x rows
+    row_b = max(N1 * Nc * csz, N1 * Nmesh2 * jnp.dtype(rdt).itemsize)
+    r0 = _chunk_rows(N0, row_b, op_target)
+    out = jnp.zeros((N0, N1, Nmesh2), rdt)
+
+    def body_b(i, out):
+        sl = jax.lax.dynamic_slice(z, (i * r0, 0, 0), (r0, N1, Nc))
+        s = jnp.fft.irfft(jnp.fft.ifft(sl, axis=1, norm=norm),
+                          n=Nmesh2, axis=2, norm=norm)
+        return jax.lax.dynamic_update_slice(out, s.astype(rdt),
+                                            (i * r0, 0, 0))
+
+    return jax.lax.fori_loop(0, N0 // r0, body_b, out)
 
 
 def dist_rfftn(x, mesh=None, norm=None):
@@ -54,6 +233,12 @@ def dist_rfftn(x, mesh=None, norm=None):
     """
     nproc = mesh_size(mesh)
     if nproc == 1:
+        N0, N1, N2 = x.shape
+        target = _fft_chunk_bytes()
+        out_bytes = N0 * N1 * (N2 // 2 + 1) * (
+            8 if x.dtype.itemsize <= 4 else 16)
+        if target and out_bytes > target:
+            return _rfftn_single_chunked(x, norm, target)
         y = jnp.fft.rfftn(x, norm=norm)
         return jnp.transpose(y, (1, 0, 2))
 
@@ -91,6 +276,9 @@ def dist_irfftn(y, Nmesh2, mesh=None, norm=None):
     """
     nproc = mesh_size(mesh)
     if nproc == 1:
+        target = _fft_chunk_bytes()
+        if target and y.nbytes > target:
+            return _irfftn_single_chunked(y, Nmesh2, norm, target)
         yt = jnp.transpose(y, (1, 0, 2))
         return jnp.fft.irfftn(yt, s=(yt.shape[0], yt.shape[1], Nmesh2), norm=norm)
 
