@@ -389,12 +389,16 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
                 lambda c: phase_fns['comp_pow'](c * (1.0 / pm.Ntot)),
                 donate_argnums=0)
 
-            def s_fft(field):
-                box = [field]
-                del field  # box holds the only ref -> freeable mid-FFT
-                return _dfft.rfftn_single_lowmem(box)
+            def run_once():
+                # the one-element box is built HERE so no caller stack
+                # slot references the 4.3 GB field during the FFT call
+                # (pre-3.11 CPython keeps argument stack refs alive for
+                # the whole call) — the lowmem driver empties the box
+                # and frees the field after its first pass
+                box = [s_paint(pos)]
+                return s_bin(s_cpow(_dfft.rfftn_single_lowmem(box)))
 
-            run_once = lambda: s_bin(s_cpow(s_fft(s_paint(pos))))
+            s_fft = lambda field: _dfft.rfftn_single_lowmem([field])
         else:
             s_power = jax.jit(phase_fns['field_power'], donate_argnums=0)
             run_once = lambda: s_bin(s_power(s_paint(pos)))
@@ -691,10 +695,14 @@ def _cache_tpu_result(rec):
     if rec.get('error'):
         return  # an error-flagged timing must never become a headline
     prev = cache['results'].get(rec['metric'])
-    if prev and not prev.get('error') and \
-            0 < prev.get('value', -1) < rec.get('value', -1):
-        return  # keep the fastest VALID measurement of this config
-        # (equal value falls through: a same-run refresh adds phases)
+    if prev and not prev.get('error'):
+        pv = prev.get('value', -1)
+        if 0 < pv < rec.get('value', -1):
+            return  # keep the fastest VALID measurement of this config
+        if pv == rec.get('value', -1) and prev.get('phases') \
+                and not rec.get('phases'):
+            return  # an equal-value tie only replaces to ADD phase
+            # data (the same-run refresh), never to drop it
     cache['results'][rec['metric']] = rec
     tmp = TPU_CACHE_PATH + '.tmp'
     with open(tmp, 'w') as f:
@@ -715,6 +723,9 @@ def _cache_cpu_baseline(rec):
     except (OSError, ValueError):
         data = {"results": {}}
     prev = data['results'].get(rec['metric'])
+    if prev and prev.get('value', -1) == rec['value'] \
+            and prev.get('phases') and not rec.get('phases'):
+        return  # equal-value tie must not drop phase data
     if prev and 0 < prev.get('value', -1) < rec['value']:
         # keep the FASTEST CPU measurement: the baseline is what the
         # CPU can do, and runs taken while other workers contend for

@@ -297,6 +297,63 @@ def dist_irfftn(y, Nmesh2, mesh=None, norm=None):
         out_specs=P(AXIS, None, None))(y)
 
 
+def _fftn_c2c_single_chunked(x, inverse, norm, target):
+    """Slab-chunked per-axis c2c transform (same rationale as
+    :func:`_rfftn_single_chunked`: no FFT op ever spans a multi-GB
+    buffer).  Forward maps (N0, N1, N2) -> transposed (N1, N0, N2);
+    inverse is the exact reverse."""
+    fft = jnp.fft.ifft if inverse else jnp.fft.fft
+    op_target = max(target // 4, 1)
+    csz = x.dtype.itemsize
+    if inverse:
+        N1, N0, N2 = x.shape
+    else:
+        N0, N1, N2 = x.shape
+
+    if not inverse:
+        # pass A: fft z + fft y over x-slabs; pass B: fft x over
+        # y-slabs, written transposed
+        r0 = _chunk_rows(N0, N1 * N2 * csz, op_target)
+        y = jnp.zeros((N0, N1, N2), x.dtype)
+
+        def body_a(i, y):
+            sl = jax.lax.dynamic_slice(x, (i * r0, 0, 0), (r0, N1, N2))
+            s = fft(fft(sl, axis=2, norm=norm), axis=1, norm=norm)
+            return jax.lax.dynamic_update_slice(y, s, (i * r0, 0, 0))
+
+        y = jax.lax.fori_loop(0, N0 // r0, body_a, y)
+        r1 = _chunk_rows(N1, N0 * N2 * csz, op_target)
+        out = jnp.zeros((N1, N0, N2), x.dtype)
+
+        def body_b(j, out):
+            sl = jax.lax.dynamic_slice(y, (0, j * r1, 0), (N0, r1, N2))
+            s = jnp.transpose(fft(sl, axis=0, norm=norm), (1, 0, 2))
+            return jax.lax.dynamic_update_slice(out, s, (j * r1, 0, 0))
+
+        return jax.lax.fori_loop(0, N1 // r1, body_b, out)
+
+    # inverse: undo fft x (axis 1 of the transposed layout) over
+    # ky-slabs, then fft y + fft z over x-slabs
+    r1 = _chunk_rows(N1, N0 * N2 * csz, op_target)
+    z = jnp.zeros((N0, N1, N2), x.dtype)
+
+    def body_a(j, z):
+        sl = jax.lax.dynamic_slice(x, (j * r1, 0, 0), (r1, N0, N2))
+        s = jnp.transpose(fft(sl, axis=1, norm=norm), (1, 0, 2))
+        return jax.lax.dynamic_update_slice(z, s, (0, j * r1, 0))
+
+    z = jax.lax.fori_loop(0, N1 // r1, body_a, z)
+    r0 = _chunk_rows(N0, N1 * N2 * csz, op_target)
+    out = jnp.zeros((N0, N1, N2), x.dtype)
+
+    def body_b(i, out):
+        sl = jax.lax.dynamic_slice(z, (i * r0, 0, 0), (r0, N1, N2))
+        s = fft(fft(sl, axis=1, norm=norm), axis=2, norm=norm)
+        return jax.lax.dynamic_update_slice(out, s, (i * r0, 0, 0))
+
+    return jax.lax.fori_loop(0, N0 // r0, body_b, out)
+
+
 def dist_fftn_c2c(x, mesh=None, inverse=False, norm=None):
     """Full complex-to-complex 3-D FFT, transposed layout in/out.
 
@@ -307,6 +364,9 @@ def dist_fftn_c2c(x, mesh=None, inverse=False, norm=None):
     nproc = mesh_size(mesh)
     fft = jnp.fft.ifft if inverse else jnp.fft.fft
     if nproc == 1:
+        target = _fft_chunk_bytes()
+        if target and x.nbytes > target:
+            return _fftn_c2c_single_chunked(x, inverse, norm, target)
         if inverse:
             y = jnp.transpose(x, (1, 0, 2))
             return jnp.fft.ifftn(y, norm=norm)
