@@ -398,7 +398,13 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
                 box = [s_paint(pos)]
                 return s_bin(s_cpow(_dfft.rfftn_single_lowmem(box)))
 
-            s_fft = lambda field: _dfft.rfftn_single_lowmem([field])
+            def s_fft(field):
+                # box + del so the callee-frame ref doesn't pin the
+                # field through the FFT (phase-split chains route
+                # through here; run_once boxes at the call site)
+                box = [field]
+                del field
+                return _dfft.rfftn_single_lowmem(box)
         else:
             s_power = jax.jit(phase_fns['field_power'], donate_argnums=0)
             run_once = lambda: s_bin(s_power(s_paint(pos)))

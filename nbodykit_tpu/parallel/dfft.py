@@ -95,6 +95,74 @@ def rfftn_single_lowmem(x_box, norm=None, target=None):
     return out
 
 
+def irfftn_single_lowmem(y_box, Nmesh2, norm=None, target=None):
+    """Eager inverse of :func:`rfftn_single_lowmem` (same ownership and
+    peak-memory contract: pass the transposed complex field in a
+    one-element list; ~2 full-mesh buffers peak)."""
+    y = y_box.pop() if isinstance(y_box, list) else y_box
+    if target is None:
+        target = _fft_chunk_bytes() or 2 ** 31
+    progs = _lowmem_inv_programs(y.shape, str(y.dtype), int(Nmesh2),
+                                 norm, int(target))
+    r1, r0, zeros_z, zeros_out, slab_a, upd_a, slab_b, upd_b = progs
+    N1, N0, _ = y.shape
+
+    # pass A: undo the x-axis fft, chunked over ky rows (in-place)
+    z = zeros_z()
+    for j in range(N1 // r1):
+        jdx = jnp.int32(j * r1)
+        z = upd_a(z, slab_a(y, jdx), jdx)
+    del y
+
+    # pass B: ifft over ky + irfft over kz, chunked over x rows
+    out = zeros_out()
+    for i in range(N0 // r0):
+        idx = jnp.int32(i * r0)
+        out = upd_b(out, slab_b(z, idx), idx)
+    return out
+
+
+@_lru_cache(maxsize=16)
+def _lowmem_inv_programs(shape, dtype_str, Nmesh2, norm, target):
+    """Jitted stage programs for :func:`irfftn_single_lowmem`."""
+    N1, N0, Nc = shape
+    csz = jnp.dtype(dtype_str).itemsize
+    cdt = jnp.dtype(dtype_str)
+    rdt = jnp.float32 if csz <= 8 else jnp.float64
+    op_target = max(target // 4, 1)
+    r1 = _chunk_rows(N1, N0 * Nc * csz, op_target)
+    row_b = max(N1 * Nc * csz, N1 * Nmesh2 * jnp.dtype(rdt).itemsize)
+    r0 = _chunk_rows(N0, row_b, op_target)
+
+    def _upd_a(dst, s, j):
+        z = jnp.zeros((), j.dtype)
+        return jax.lax.dynamic_update_slice(dst, s, (z, j, z))
+
+    def _upd_b(dst, s, i):
+        z = jnp.zeros((), i.dtype)
+        return jax.lax.dynamic_update_slice(dst, s, (i, z, z))
+
+    @jax.jit
+    def slab_a(y, j):
+        z = jnp.zeros((), j.dtype)
+        yc = jax.lax.dynamic_slice(y, (j, z, z), (r1, N0, Nc))
+        return jnp.transpose(jnp.fft.ifft(yc, axis=1, norm=norm),
+                             (1, 0, 2))
+
+    @jax.jit
+    def slab_b(zf, i):
+        z = jnp.zeros((), i.dtype)
+        sl = jax.lax.dynamic_slice(zf, (i, z, z), (r0, N1, Nc))
+        return jnp.fft.irfft(jnp.fft.ifft(sl, axis=1, norm=norm),
+                             n=Nmesh2, axis=2, norm=norm).astype(rdt)
+
+    zeros_z = jax.jit(lambda: jnp.zeros((N0, N1, Nc), cdt))
+    zeros_out = jax.jit(lambda: jnp.zeros((N0, N1, Nmesh2), rdt))
+    return (r1, r0, zeros_z, zeros_out, slab_a,
+            jax.jit(_upd_a, donate_argnums=(0,)), slab_b,
+            jax.jit(_upd_b, donate_argnums=(0,)))
+
+
 @_lru_cache(maxsize=16)
 def _lowmem_programs(shape, dtype_str, norm, target):
     """Jitted stage programs for :func:`rfftn_single_lowmem`, cached per

@@ -63,6 +63,18 @@ def test_rfftn_single_lowmem_matches_plain():
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=1e-4)
 
 
+def test_irfftn_single_lowmem_roundtrip():
+    import nbodykit_tpu
+    rng = np.random.RandomState(13)
+    x = rng.standard_normal((8, 10, 12)).astype(np.float32)
+    with nbodykit_tpu.set_options(fft_chunk_bytes=1024):
+        y = dfft.rfftn_single_lowmem([jnp.asarray(x)])
+        box = [y]
+        back = dfft.irfftn_single_lowmem(box, 12)
+    assert box == []
+    np.testing.assert_allclose(np.asarray(back), x, rtol=2e-4, atol=1e-4)
+
+
 def test_chunked_c2c_matches_plain_and_roundtrips():
     import nbodykit_tpu
     rng = np.random.RandomState(5)
