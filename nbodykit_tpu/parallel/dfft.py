@@ -306,6 +306,14 @@ def dist_rfftn(x, mesh=None, norm=None):
         out_bytes = N0 * N1 * (N2 // 2 + 1) * (
             8 if x.dtype.itemsize <= 4 else 16)
         if target and out_bytes > target:
+            if not isinstance(x, jax.core.Tracer):
+                # eager call on a concrete field (the production
+                # compute() pipeline composes eagerly): the Python-
+                # driven lowmem driver peaks ~1 full-mesh buffer lower
+                # than the in-jit chunked program and avoids eager
+                # multi-GB ops the backend may not support
+                return rfftn_single_lowmem([x], norm=norm,
+                                           target=target)
             return _rfftn_single_chunked(x, norm, target)
         y = jnp.fft.rfftn(x, norm=norm)
         return jnp.transpose(y, (1, 0, 2))
@@ -346,6 +354,9 @@ def dist_irfftn(y, Nmesh2, mesh=None, norm=None):
     if nproc == 1:
         target = _fft_chunk_bytes()
         if target and y.nbytes > target:
+            if not isinstance(y, jax.core.Tracer):
+                return irfftn_single_lowmem([y], Nmesh2, norm=norm,
+                                            target=target)
             return _irfftn_single_chunked(y, Nmesh2, norm, target)
         yt = jnp.transpose(y, (1, 0, 2))
         return jnp.fft.irfftn(yt, s=(yt.shape[0], yt.shape[1], Nmesh2), norm=norm)

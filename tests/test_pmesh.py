@@ -33,18 +33,25 @@ def test_dist_irfftn_roundtrip(cpu8):
 
 
 @pytest.mark.parametrize('shape', [(16, 24, 20), (12, 12, 12)])
-def test_chunked_single_device_fft_matches_plain(shape):
-    # force the slab-chunked per-axis path on a tiny mesh and compare
-    # against the one-shot rfftn (and the exact round-trip back)
+@pytest.mark.parametrize('traced', [False, True])
+def test_chunked_single_device_fft_matches_plain(shape, traced):
+    # force the slab-chunked path on a tiny mesh and compare against
+    # the one-shot rfftn (and the exact round-trip back). Eager calls
+    # route through the Python-chunked lowmem driver; traced calls
+    # through the in-jit fori_loop version — both must agree.
     import nbodykit_tpu
     rng = np.random.RandomState(7)
     x = rng.standard_normal(shape)
     want = np.fft.rfftn(x).transpose(1, 0, 2)
+    fwd = (jax.jit(lambda v: dfft.dist_rfftn(v, None)) if traced
+           else (lambda v: dfft.dist_rfftn(v, None)))
+    inv = (jax.jit(lambda v: dfft.dist_irfftn(v, shape[2], None))
+           if traced else (lambda v: dfft.dist_irfftn(v, shape[2], None)))
     with nbodykit_tpu.set_options(fft_chunk_bytes=1024):
-        got = dfft.dist_rfftn(jnp.asarray(x), None)
+        got = fwd(jnp.asarray(x))
         np.testing.assert_allclose(np.asarray(got), want,
                                    rtol=1e-9, atol=1e-8)
-        back = dfft.dist_irfftn(got, shape[2], None)
+        back = inv(got)
     np.testing.assert_allclose(np.asarray(back), x, rtol=1e-9, atol=1e-9)
 
 
