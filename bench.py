@@ -744,7 +744,11 @@ def _flush_detail(detail):
 def _cache_tpu_result(rec):
     """Merge one real-TPU config record into the committed cache
     (atomic; keyed by metric, latest wins)."""
-    if rec.get('platform') not in TPU_PLATFORMS:
+    if rec.get('platform') not in TPU_PLATFORMS \
+            or rec.get('platform') == 'cpu':
+        # the explicit cpu check is a belt against test harnesses that
+        # widen TPU_PLATFORMS (a CPU rehearsal once leaked a cpu record
+        # into the committed TPU cache this way)
         return
     try:
         with open(TPU_CACHE_PATH) as f:
@@ -813,6 +817,10 @@ def _best_cached_tpu():
     for rec in cache.get('results', {}).values():
         if not str(rec.get('metric', '')).startswith('fftpower'):
             continue  # the headline is the flagship FFTPower ladder
+        if rec.get('platform') not in TPU_PLATFORMS \
+                or rec.get('platform') == 'cpu':
+            continue  # the claim made from this cache is 'real-TPU
+            # measurement' — filter at read time too, not just write
         if rec.get('value') and rec.get('value', -1) > 0:
             # prefer the largest mesh (metric names sort by Nmesh
             # numerically via the recorded nmesh field if present)
