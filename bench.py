@@ -73,6 +73,16 @@ WORKER_LOG = os.environ.get(
 # the end-of-round bench can report it even if the tunnel is down then.
 TPU_CACHE_PATH = os.path.join(HERE, 'BENCH_TPU_CACHE.json')
 TOTAL_BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', 1500))
+# in-progress measurements staged here (atomic) BEFORE the final
+# timing barrier, so a tunnel death mid-timing leaves the partial
+# number on disk (round 5 lost the 1024^3/1e7 record exactly there)
+STAGED_PATH = os.environ.get('BENCH_STAGED_PATH',
+                             os.path.join(HERE, 'BENCH_STAGED.json'))
+# crash-safe span trace of every worker phase (nbodykit_tpu.
+# diagnostics, docs/OBSERVABILITY.md); set BENCH_TRACE_DIR='' to
+# disable
+TRACE_DIR = os.environ.get('BENCH_TRACE_DIR',
+                           os.path.join(HERE, 'BENCH_TRACE'))
 
 TPU_PLATFORMS = ('tpu', 'axon')
 
@@ -93,13 +103,20 @@ def _setup_jax():
         n = int(m.group(1)) if m else int(
             os.environ.get('JAX_NUM_CPU_DEVICES', '0') or 0)
         if n > 1:
-            jax.config.update('jax_num_cpu_devices', n)
+            from nbodykit_tpu._jax_compat import set_cpu_devices
+            set_cpu_devices(n)
     # persistent compile cache: the ladder re-jits the same programs
     # (and a re-run after a tunnel wedge should not pay compiles again);
     # same dir + env override as __graft_entry__._enable_compile_cache
     # so the dryrun/bench/test caches stay shared
     import __graft_entry__
     __graft_entry__._enable_compile_cache()
+    if TRACE_DIR:
+        # every worker phase below emits crash-safe spans: a wedged
+        # tunnel or a kill leaves BENCH_TRACE/trace-<pid>.jsonl
+        # readable (python -m nbodykit_tpu.diagnostics --report ...)
+        import nbodykit_tpu
+        nbodykit_tpu.set_options(diagnostics=TRACE_DIR)
     return jax
 
 
@@ -247,15 +264,24 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
     return fftpower, phases
 
 
-def _time_fn(jax, fn, args, reps):
-    out = fn(*args)
-    t0 = time.time()
-    _sync(jax, out)
-    compile_s = time.time() - t0  # first-call includes compile
-    t0 = time.time()
-    for _ in range(reps):
+def _time_fn(jax, fn, args, reps, label='fn', on_warm=None):
+    """Warm (compile) + timed reps.  ``on_warm(compile_s)`` fires after
+    the warm-up sync and BEFORE the timed loop — the hook run_config
+    uses to stage a partial record ahead of the final timing barrier
+    (a tunnel death mid-reps then still leaves a number on disk)."""
+    from nbodykit_tpu.diagnostics import span
+    with span('bench.warmup', label=label):
         out = fn(*args)
+        t0 = time.time()
         _sync(jax, out)
+        compile_s = time.time() - t0  # first-call includes compile
+    if on_warm is not None:
+        on_warm(compile_s)
+    t0 = time.time()
+    for r in range(reps):
+        with span('bench.rep', label=label, rep=r):
+            out = fn(*args)
+            _sync(jax, out)
     return (time.time() - t0) / reps, compile_s
 
 
@@ -325,8 +351,10 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     # only by paint_method
     nbodykit_tpu.set_options(paint_method=method, paint_order='auto',
                              paint_deposit='auto')
+    from nbodykit_tpu.diagnostics import span as _span
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
-    pos = _make_pos(jax, jnp, Npart, 1000.0)
+    with _span('bench.make_pos', npart=Npart, nmesh=Nmesh):
+        pos = _make_pos(jax, jnp, Npart, 1000.0)
     fused, phase_fns = _bench_fftpower_fn(pm)
 
     rec = {
@@ -349,7 +377,11 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
               and (Nmesh >= 512 or method == 'mxu'))
     if not staged:
         try:
-            dt, compile_s = _time_fn(jax, jax.jit(fused), (pos,), reps)
+            dt, compile_s = _time_fn(
+                jax, jax.jit(fused), (pos,), reps, label='fused',
+                on_warm=lambda cs: _stage_partial(
+                    rec, partial=True, stage='warmed', mode='fused',
+                    first_run_s=round(cs, 4)))
             rec['mode'] = 'fused'
         except Exception as e:
             if not any(s in str(e) for s in
@@ -403,14 +435,21 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         else:
             s_power = jax.jit(phase_fns['field_power'], donate_argnums=0)
             run_once = lambda: s_bin(s_power(s_paint(pos)))
-        t0 = time.time()
-        _sync(jax, run_once())
-        compile_s = time.time() - t0
-        t0 = time.time()
-        for _ in range(reps):
+        with _span('bench.warmup', label='staged'):
+            t0 = time.time()
             _sync(jax, run_once())
+            compile_s = time.time() - t0
+        # the warmed partial record lands on disk BEFORE the timed
+        # reps — a tunnel death mid-timing no longer loses the rung
+        _stage_partial(rec, partial=True, stage='warmed', mode='staged',
+                       first_run_s=round(compile_s, 4))
+        t0 = time.time()
+        for r in range(reps):
+            with _span('bench.rep', label='staged', rep=r):
+                _sync(jax, run_once())
         dt = (time.time() - t0) / reps
     rec.update(value=round(dt, 4), compile_s=round(compile_s, 1))
+    _stage_partial(rec, partial=False, stage='complete')
     _attach_baseline(rec)
 
     if method == 'mxu':
@@ -487,7 +526,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         _cache_cpu_baseline(rec)
         print("[config] core record: %s" % json.dumps(rec), flush=True)
         try:
-            _phase_split()
+            with _span('bench.phase_split', nmesh=Nmesh):
+                _phase_split()
         except Exception as e:
             rec['phases_error'] = str(e)[:300]
         # refresh the cached records with the phase data (equal-value
@@ -523,11 +563,15 @@ def run_fkp(Nmesh=512, nbar=1e-4, reps=1):
     fkp = FKPCatalog(data, rand)
     mesh = fkp.to_mesh(Nmesh=Nmesh, resampler='tsc')
 
+    from nbodykit_tpu.diagnostics import span as _span
+
     def once():
-        cp = ConvolvedFFTPower(mesh, poles=[0, 2, 4], dk=0.005)
-        # touching the result forces completion (poles are host arrays)
-        float(np.asarray(cp.poles['power_0'].real)[0])
-        return cp
+        with _span('bench.fkp_rep', nmesh=Nmesh):
+            cp = ConvolvedFFTPower(mesh, poles=[0, 2, 4], dk=0.005)
+            # touching the result forces completion (poles are host
+            # arrays)
+            float(np.asarray(cp.poles['power_0'].real)[0])
+            return cp
 
     # warm (compiles included in first run)
     t0 = time.time()
@@ -721,7 +765,8 @@ def run_paint(Nmesh, Npart, method='scatter', reps=3):
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic',
                                     return_dropped=True)[0])
-    dt, _ = _time_fn(jax, fn, (pos,), reps)
+    dt, _ = _time_fn(jax, fn, (pos,), reps,
+                     label='paint_%s' % method_label)
     return {
         "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"
                   % (Nmesh, Npart, method_label),
@@ -739,6 +784,32 @@ def _flush_detail(detail):
     with open(tmp, 'w') as f:
         json.dump(detail, f, indent=1)
     os.replace(tmp, DETAIL_PATH)
+
+
+def _stage_partial(rec, **extra):
+    """Merge one in-progress config record into BENCH_STAGED.json
+    (atomic tmp+rename, keyed by metric).
+
+    Called BEFORE the final device sync/timing barrier: round 5 lost
+    the 1024^3/1e7 record because the tunnel died mid-timing and every
+    flush ran only after — now the warmed measurement (first-run wall,
+    compile included) survives any death during the timed reps, and
+    the completed record overwrites it in place.
+    """
+    try:
+        with open(STAGED_PATH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {"results": {}}
+    rec = dict(rec)
+    rec.update(extra)
+    rec['staged_at'] = time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                     time.gmtime())
+    data['results'][str(rec.get('metric', '?'))] = rec
+    tmp = STAGED_PATH + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(data, f, indent=1)
+    os.replace(tmp, STAGED_PATH)
 
 
 def _cache_tpu_result(rec):

@@ -22,6 +22,7 @@ mock generation, cosmology, IO, and batch processing.
 """
 
 import logging
+import os
 import time
 from contextlib import contextmanager
 
@@ -59,6 +60,12 @@ _default_options = {
     # multi-GB buffer exceeds TPU compiler limits; see parallel/dfft).
     # 0 disables chunking.
     'fft_chunk_bytes': 2 ** 31,
+    # telemetry sink: None disables; a path enables the span tracer +
+    # crash-safe JSONL trace (nbodykit_tpu.diagnostics, docs/
+    # OBSERVABILITY.md). Seeded from $NBKIT_DIAGNOSTICS so detached
+    # workers (bench, multi-host) can be told to leave a post-mortem
+    # trace without code changes.
+    'diagnostics': os.environ.get('NBKIT_DIAGNOSTICS') or None,
 }
 
 
@@ -140,6 +147,11 @@ class set_options(object):
     fft_chunk_bytes : int
         single-device FFTs with complex output larger than this run as
         slab-chunked per-axis passes (0 disables).
+    diagnostics : str or None
+        path of the telemetry sink (a directory, or a ``*.jsonl``
+        file): enables the span tracer + metrics of
+        :mod:`nbodykit_tpu.diagnostics` with crash-safe JSONL output.
+        None (the default) disables all tracing at zero cost.
     """
 
     def __init__(self, **kwargs):
@@ -201,9 +213,16 @@ def setup_logging(log_level="info"):
 @contextmanager
 def timer(name, logger=None):
     """Context manager timing a named phase (reference: utils.timer,
-    nbodykit/utils.py:491)."""
+    nbodykit/utils.py:491).
+
+    Routed through :mod:`nbodykit_tpu.diagnostics`: when the
+    ``diagnostics`` option is set, every existing ``timer(...)`` call
+    site also emits a crash-safe ``timer.<name>`` span with zero
+    caller changes (no-op otherwise)."""
+    from .diagnostics import span
     t0 = time.time()
-    yield
+    with span('timer.%s' % name):
+        yield
     dt = time.time() - t0
     msg = "%s: %.3f s" % (name, dt)
     if logger is not None:
@@ -212,6 +231,7 @@ def timer(name, logger=None):
         logging.getLogger('timer').info(msg)
 
 
+from . import _jax_compat  # noqa: E402,F401  (backfills jax.shard_map on old jax)
 from .parallel.runtime import CurrentMesh, use_mesh, cpu_mesh, tpu_mesh  # noqa: E402,F401
 
 
@@ -219,10 +239,16 @@ from .parallel.runtime import CurrentMesh, use_mesh, cpu_mesh, tpu_mesh  # noqa:
 def profile(path='/tmp/nbodykit-tpu-trace', host=False):
     """Capture a jax profiler trace of the enclosed block (SURVEY.md §5
     'tracing': the reference has wall-clock phase logging only; here the
-    full XLA timeline lands in TensorBoard format at ``path``)."""
+    full XLA timeline lands in TensorBoard format at ``path``).
+
+    Also emits a ``profile`` span (with the trace path) when the
+    ``diagnostics`` option is set, so the XLA capture window is
+    locatable inside the span timeline."""
     import jax
+    from .diagnostics import span
     jax.profiler.start_trace(path)
     try:
-        yield path
+        with span('profile', path=path, host=bool(host)):
+            yield path
     finally:
         jax.profiler.stop_trace()

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ..base.catalog import CatalogSourceBase
 from ..base.mesh import MeshSource, Field, FieldMesh
 from ..binned_statistic import BinnedStatistic
+from ..diagnostics import NULL_SPAN, span_eager
 from ..utils import JSONEncoder, JSONDecoder, as_numpy
 
 
@@ -304,7 +305,14 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
     else:
         _bin = jax.jit(lambda v: tuple(_block_hists(v, 0)))
 
-    hs = _bin(value)
+    _sp = span_eager('fftpower.binning', nstreams=nstreams,
+                     shape=[int(s) for s in value.shape])
+    with _sp:
+        hs = _bin(value)
+        if _sp is not NULL_SPAN:
+            # binning is async-dispatched; sync inside the span so its
+            # wall is the work, not the dispatch (enabled-mode only)
+            hs = jax.block_until_ready(hs)
     xsum, musum, Nsum = hs[0], hs[1], hs[2]
     ys_re, ys_im = [], []
     k = 3
@@ -560,7 +568,9 @@ class FFTPower(FFTBase):
         self.attrs['kmin'] = kmin
         self.attrs['kmax'] = kmax
 
-        self.power, self.poles = self.run()
+        with span_eager('fftpower.run', mode=mode,
+                        nmesh=int(self.attrs['Nmesh'][0])):
+            self.power, self.poles = self.run()
         self.attrs.update(self.power.attrs)
 
     def run(self):

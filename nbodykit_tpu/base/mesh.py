@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ..pmesh import ParticleMesh
 from ..parallel.runtime import CurrentMesh
 from ..utils import as_numpy
+from ..diagnostics import device_watermarks, enabled, span_eager
 
 logger = logging.getLogger('MeshSource')
 
@@ -67,12 +68,15 @@ class Field(object):
 
     def r2c(self):
         assert self.kind == 'real'
-        return Field(self.pm.r2c(self.value), self.pm, 'complex',
-                     self.attrs)
+        with span_eager('mesh.r2c', shape=[int(s) for s in self.shape]):
+            return Field(self.pm.r2c(self.value), self.pm, 'complex',
+                         self.attrs)
 
     def c2r(self):
         assert self.kind == 'complex'
-        return Field(self.pm.c2r(self.value), self.pm, 'real', self.attrs)
+        with span_eager('mesh.c2r', shape=[int(s) for s in self.shape]):
+            return Field(self.pm.c2r(self.value), self.pm, 'real',
+                         self.attrs)
 
     def apply(self, func, kind=None):
         """Apply ``func(coords, value) -> value`` immediately with the
@@ -229,26 +233,35 @@ class MeshSource(object):
         if mode not in ('real', 'complex'):
             raise ValueError("mode must be 'real' or 'complex'")
 
-        # decide the starting representation: prefer the native one
-        native_real = type(self).to_real_field is not MeshSource.to_real_field
-        field = self.to_field('real' if native_real else 'complex')
+        with span_eager('mesh.compute', mode=mode,
+                        cls=type(self).__name__,
+                        nactions=len(self.actions)):
+            # decide the starting representation: prefer the native one
+            native_real = (type(self).to_real_field
+                           is not MeshSource.to_real_field)
+            field = self.to_field('real' if native_real else 'complex')
 
-        for amode, func, kind in self.actions:
-            if amode == 'real' and field.kind != 'real':
+            for amode, func, kind in self.actions:
+                if amode == 'real' and field.kind != 'real':
+                    field = field.c2r()
+                elif amode == 'complex' and field.kind != 'complex':
+                    field = field.r2c()
+                field = field.apply(func, kind=kind)
+
+            if Nmesh is not None and any(
+                    np.atleast_1d(Nmesh) != self.pm.Nmesh):
+                field = self._resample(field, Nmesh)
+
+            if mode == 'real' and field.kind != 'real':
                 field = field.c2r()
-            elif amode == 'complex' and field.kind != 'complex':
+            elif mode == 'complex' and field.kind != 'complex':
                 field = field.r2c()
-            field = field.apply(func, kind=kind)
-
-        if Nmesh is not None and any(
-                np.atleast_1d(Nmesh) != self.pm.Nmesh):
-            field = self._resample(field, Nmesh)
-
-        if mode == 'real' and field.kind != 'real':
-            field = field.c2r()
-        elif mode == 'complex' and field.kind != 'complex':
-            field = field.r2c()
-        return field
+            if enabled():
+                # per-device live-buffer watermarks at the end of each
+                # compute phase: the gauge maxima answer "what was HBM
+                # holding when it OOMed" post-mortem
+                device_watermarks()
+            return field
 
     paint = compute
 

@@ -1,0 +1,116 @@
+"""nbodykit_tpu.diagnostics — structured tracing, metrics and
+crash-safe telemetry for every hot path.
+
+The reference nbodykit only ever had ad-hoc wall-clock logging
+(SURVEY §L0); a production-scale TPU stack needs first-class
+observability that *survives the run dying* — the recurring failure
+mode here is an axon tunnel death mid-measurement that loses the
+evidence (ISSUE #1 / round-5 verdict).  Three pieces:
+
+- :mod:`.trace` — a low-overhead span tracer (context manager +
+  decorator, monotonic clocks, per-thread nesting, exception-safe)
+  emitting crash-safe JSONL (append + fsync per completed span) and a
+  Perfetto/chrome-trace export.  No-op when disabled.
+- :mod:`.metrics` — process-wide counters/gauges/histograms (exchange
+  bytes, FFT chunk walls, paint Mpart/s per kernel, device live-buffer
+  watermarks).
+- :mod:`.report` — end-of-run summary (per-phase wall, top spans,
+  metric tables) as JSON + text, written atomically.
+
+Enable with ``nbodykit_tpu.set_options(diagnostics='/tmp/trace')`` (or
+``$NBKIT_DIAGNOSTICS``); self-check with
+``python -m nbodykit_tpu.diagnostics --self-check``.  Full guide:
+docs/OBSERVABILITY.md.
+"""
+
+import functools
+
+from .trace import (NULL_SPAN, Tracer, atomic_write, current_tracer,  # noqa: F401
+                    export_chrome_trace, read_trace, trace_files,
+                    trace_state_clean)
+from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, counter, gauge, histogram,
+                      device_watermarks)
+from .report import render_text, summarize, write_report  # noqa: F401
+
+
+def enabled():
+    """True when a trace sink is configured (the ``diagnostics``
+    option is set)."""
+    return current_tracer() is not None
+
+
+def configure(path):
+    """Enable tracing to ``path`` (a directory, or a ``*.jsonl`` file)
+    process-wide; ``configure(None)`` disables.  Equivalent to
+    ``set_options(diagnostics=path)`` as a plain call.  Returns the
+    active tracer (or None)."""
+    from .. import _global_options
+    _global_options['diagnostics'] = path
+    return current_tracer()
+
+
+def span(name, **attrs):
+    """A timed, nested span::
+
+        with span('paint', method='mxu', npart=n):
+            ...
+
+    Returns a shared no-op context manager when diagnostics are
+    disabled — safe (and free) to leave in hot paths.  Attributes must
+    be JSON-serializable (anything else is stringified)."""
+    t = current_tracer()
+    if t is None:
+        return NULL_SPAN
+    return t.span(name, attrs)
+
+
+def span_if(cond, name, **attrs):
+    """:func:`span` gated on ``cond`` — the idiom for call sites that
+    may run under a jax trace, where host-side timing is meaningless
+    (pass e.g. ``not isinstance(x, jax.core.Tracer)``)."""
+    if not cond:
+        return NULL_SPAN
+    t = current_tracer()
+    if t is None:
+        return NULL_SPAN
+    return t.span(name, attrs)
+
+
+def span_eager(name, **attrs):
+    """:func:`span`, but a no-op while jax is staging a trace
+    (jit/scan/shard_map) — for call sites without a handy operand to
+    test for tracer-ness."""
+    t = current_tracer()
+    if t is None or not trace_state_clean():
+        return NULL_SPAN
+    return t.span(name, attrs)
+
+
+def traced(name=None):
+    """Decorator form of :func:`span`::
+
+        @traced()               # span named module.qualname
+        def load_catalog(...): ...
+
+        @traced('io.read')      # explicit span name
+        def read(...): ...
+    """
+    def deco(fn):
+        label = name or '%s.%s' % (fn.__module__, fn.__qualname__)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t = current_tracer()
+            if t is None:
+                return fn(*args, **kwargs)
+            with t.span(label):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def current_trace_file():
+    """Path of the active trace file, or None."""
+    t = current_tracer()
+    return t.path if t is not None else None

@@ -1,0 +1,148 @@
+"""Diagnostics CLI: self-check, post-mortem report, chrome export.
+
+    python -m nbodykit_tpu.diagnostics --self-check
+        Round-trip a trace file end to end: emit nested + failing
+        spans and metrics, simulate a killed writer (torn final line),
+        replay, write the report and the chrome-trace export, verify
+        every step.  Exit 0 on success.  Run by scripts/smoke.sh and
+        installed as the ``nbodykit-tpu-selfcheck`` console script.
+
+    python -m nbodykit_tpu.diagnostics --report PATH
+        Print the text report for an existing trace file/directory
+        (e.g. from a dead TPU run).
+
+    python -m nbodykit_tpu.diagnostics --chrome PATH
+        Export PATH to chrome_trace.json for ui.perfetto.dev.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+
+def self_check(path=None, verbose=True):
+    """Returns 0 on success; raises AssertionError on any mismatch."""
+    import nbodykit_tpu
+    from . import (NULL_SPAN, REGISTRY, counter, current_tracer,
+                   export_chrome_trace, histogram, read_trace, span,
+                   write_report)
+
+    tmp = None
+    if path is None:
+        tmp = path = tempfile.mkdtemp(prefix='nbodykit-tpu-diag-')
+    try:
+        # disabled mode really is a no-op singleton
+        with nbodykit_tpu.set_options(diagnostics=None):
+            assert span('off') is NULL_SPAN
+            assert current_tracer() is None
+
+        with nbodykit_tpu.set_options(diagnostics=path):
+            tr = current_tracer()
+            assert tr is not None, 'tracer did not come up'
+            with span('selfcheck', kind='root'):
+                with span('selfcheck.child'):
+                    counter('selfcheck.count').add(3)
+                    histogram('selfcheck.hist').observe(1.5)
+                try:
+                    with span('selfcheck.raises'):
+                        raise RuntimeError('expected failure')
+                except RuntimeError:
+                    pass
+            trace_file = tr.path
+
+            # simulate a SIGKILLed writer: a torn final line must be
+            # tolerated, not poison the replay
+            with open(trace_file, 'a') as f:
+                f.write('{"t":"span","name":"torn')
+
+            records, bad = read_trace(trace_file)
+            spans = [r for r in records if r.get('t') == 'span']
+            names = {r['name'] for r in spans}
+            assert bad == 1, 'torn-line count: %d' % bad
+            assert {'selfcheck', 'selfcheck.child',
+                    'selfcheck.raises'} <= names, names
+            child = next(r for r in spans
+                         if r['name'] == 'selfcheck.child')
+            root = next(r for r in spans if r['name'] == 'selfcheck')
+            assert child['depth'] == 1 and child['par'] == root['id'], \
+                'nesting broken: %r' % child
+            failed = next(r for r in spans
+                          if r['name'] == 'selfcheck.raises')
+            assert failed['ok'] is False \
+                and 'expected failure' in failed.get('exc', ''), failed
+
+            chrome = export_chrome_trace(trace_file)
+            with open(chrome) as f:
+                events = json.load(f)['traceEvents']
+            assert any(e['name'] == 'selfcheck' for e in events)
+
+            snap = REGISTRY.snapshot()
+            assert snap['selfcheck.count']['value'] == 3
+            assert snap['selfcheck.hist']['count'] == 1
+
+            paths = write_report(tracer=tr)
+            assert paths is not None
+            with open(paths[0]) as f:
+                rep = json.load(f)
+            assert rep['torn_lines'] == 1
+            assert rep['spans']['selfcheck.raises']['errors'] == 1
+        # the option restore must tear the tracer down again
+        assert current_tracer() is None
+        if verbose:
+            print('diagnostics self-check OK: %d spans round-tripped, '
+                  '1 torn line tolerated, report at %s'
+                  % (len(spans), paths[1]))
+        return 0
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='python -m nbodykit_tpu.diagnostics',
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('--self-check', action='store_true',
+                    help='round-trip a trace end to end; exit 0 on '
+                         'success')
+    ap.add_argument('--path', default=None,
+                    help='directory for --self-check artifacts '
+                         '(default: a private temp dir, removed after)')
+    ap.add_argument('--report', metavar='TRACE',
+                    help='print the text report for a trace '
+                         'file/directory')
+    ap.add_argument('--chrome', metavar='TRACE',
+                    help='export a trace to chrome_trace.json')
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check(args.path)
+    if args.report:
+        from . import render_text, summarize
+        if not os.path.exists(args.report):
+            print('no such trace: %s' % args.report, file=sys.stderr)
+            return 2
+        sys.stdout.write(render_text(summarize(trace_path=args.report)))
+        return 0
+    if args.chrome:
+        from . import export_chrome_trace
+        print(export_chrome_trace(args.chrome))
+        return 0
+    ap.print_help()
+    return 2
+
+
+def main_selfcheck(argv=None):
+    """Entry point for the ``nbodykit-tpu-selfcheck`` console script:
+    a bare invocation runs ``--self-check``; any explicit arguments
+    are passed through to :func:`main` unchanged."""
+    argv = sys.argv[1:] if argv is None else argv
+    return main(argv or ['--self-check'])
+
+
+if __name__ == '__main__':
+    sys.exit(main())

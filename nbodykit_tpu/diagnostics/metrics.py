@@ -1,0 +1,192 @@
+"""Process-wide metric registry: counters, gauges, histograms.
+
+The numeric companion to the span tracer (trace.py): spans answer
+"where did the wall clock go", metrics answer "how much work moved" —
+exchange bytes shipped, FFT chunks executed, paint throughput per
+kernel, retry counts, per-device live-buffer watermarks.
+
+Metrics are always-on (recording is a dict lookup + a lock-guarded
+add — cheap enough for every hot path) and land on disk only through
+the report writer (report.py) or a snapshot, so they impose no file
+I/O on the measured code.  ``REGISTRY.reset()`` restores a pristine
+registry (tests isolate through it).
+
+Instrumentation that runs *inside* a jitted function executes once per
+trace (compilation), not once per device execution — counters bumped
+there (e.g. ops/paint.py's kernel-trace counters) are labeled
+``*.trace.*`` to make that explicit.
+"""
+
+import threading
+
+
+class Counter(object):
+    """Monotonic sum (``add``)."""
+
+    __slots__ = ('name', '_lock', 'value')
+
+    def __init__(self, name, lock):
+        self.name = name
+        self._lock = lock
+        self.value = 0
+
+    def add(self, n=1):
+        with self._lock:
+            self.value += n
+        return self
+
+    def snapshot(self):
+        return {'type': 'counter', 'value': self.value}
+
+
+class Gauge(object):
+    """Last-value metric with min/max watermarks (``set``)."""
+
+    __slots__ = ('name', '_lock', 'value', 'max', 'min')
+
+    def __init__(self, name, lock):
+        self.name = name
+        self._lock = lock
+        self.value = None
+        self.max = None
+        self.min = None
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+            self.max = v if self.max is None else max(self.max, v)
+            self.min = v if self.min is None else min(self.min, v)
+        return self
+
+    def snapshot(self):
+        return {'type': 'gauge', 'value': self.value,
+                'max': self.max, 'min': self.min}
+
+
+class Histogram(object):
+    """Streaming distribution summary (``observe``): count, sum, mean,
+    min/max, last.  No buckets are kept — the spans carry the
+    per-event detail; this is the cheap aggregate for the report's
+    throughput tables."""
+
+    __slots__ = ('name', '_lock', 'count', 'sum', 'min', 'max', 'last')
+
+    def __init__(self, name, lock):
+        self.name = name
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self.last = None
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.last = v
+        return self
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else None
+
+    def snapshot(self):
+        return {'type': 'histogram', 'count': self.count,
+                'sum': self.sum, 'mean': self.mean,
+                'min': self.min, 'max': self.max, 'last': self.last}
+
+
+class MetricsRegistry(object):
+    """Named metrics, one process-wide instance (``REGISTRY``).
+
+    ``counter``/``gauge``/``histogram`` get-or-create; asking for an
+    existing name with a different type raises (a typo'd re-use would
+    otherwise silently fork the data).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics = {}
+
+    def _get(self, cls, name):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, self._lock)
+            elif type(m) is not cls:
+                raise TypeError(
+                    'metric %r already registered as %s, not %s'
+                    % (name, type(m).__name__, cls.__name__))
+            return m
+
+    def counter(self, name):
+        return self._get(Counter, name)
+
+    def gauge(self, name):
+        return self._get(Gauge, name)
+
+    def histogram(self, name):
+        return self._get(Histogram, name)
+
+    def snapshot(self):
+        """A plain-dict copy of every metric, sorted by name."""
+        with self._lock:
+            return {name: m.snapshot()
+                    for name, m in sorted(self._metrics.items())}
+
+    def reset(self):
+        """Drop every metric (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._metrics)
+
+
+REGISTRY = MetricsRegistry()
+
+# module-level conveniences bound to the process-wide registry
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+
+
+def device_watermarks(registry=None):
+    """Record per-device live-buffer totals from ``jax.live_arrays()``
+    as gauges (``device.<platform>:<id>.live_bytes`` / ``.live_arrays``
+    — the gauge ``max`` is the watermark) and return them.
+
+    Best-effort: returns ``{}`` when jax is not already imported (this
+    module never forces a backend init) or the runtime refuses.
+    """
+    import sys
+    jax = sys.modules.get('jax')
+    if jax is None:
+        return {}
+    try:
+        arrs = jax.live_arrays()
+    except Exception:
+        return {}
+    per = {}
+    for a in arrs:
+        try:
+            for s in a.addressable_shards:
+                d = s.device
+                key = '%s:%d' % (d.platform, d.id)
+                st = per.setdefault(key, [0, 0])
+                st[0] += 1
+                st[1] += int(getattr(s.data, 'nbytes', 0) or 0)
+        except Exception:
+            continue
+    reg = registry if registry is not None else REGISTRY
+    out = {}
+    for key, (narr, nbytes) in sorted(per.items()):
+        reg.gauge('device.%s.live_arrays' % key).set(narr)
+        reg.gauge('device.%s.live_bytes' % key).set(nbytes)
+        out[key] = {'live_arrays': narr, 'live_bytes': nbytes}
+    return out

@@ -1,0 +1,182 @@
+"""End-of-run summary: per-phase wall, top spans, metric tables.
+
+Renders the JSONL trace (trace.py) plus the metric registry
+(metrics.py) into one JSON document and one aligned text table,
+written atomically (tmp + rename) so a death mid-write never leaves a
+torn artifact.  A report is also written automatically at clean
+interpreter exit by the tracer's atexit hook; after a killed run,
+rebuild one from the surviving trace with::
+
+    python -m nbodykit_tpu.diagnostics --report /tmp/trace
+"""
+
+import json
+import os
+import time
+
+from .trace import atomic_write, current_tracer, read_trace
+
+
+def summarize(records=None, registry=None, trace_path=None):
+    """Aggregate span records + metrics into a summary dict.
+
+    ``records`` are parsed trace records (from :func:`read_trace`);
+    pass ``trace_path`` to read them here instead.  ``registry``
+    defaults to the process-wide one; pass a snapshot dict of an
+    earlier run to summarize post-mortem.
+    """
+    bad = 0
+    if records is None:
+        records = []
+        if trace_path is not None:
+            records, bad = read_trace(trace_path)
+    spans = [r for r in records if r.get('t') == 'span']
+    # span ids are only unique within one process; a merged directory
+    # of per-process files needs the (pid, id) pair
+    begins = {(r.get('pid'), r.get('id')): r for r in records
+              if r.get('t') == 'b'}
+    for r in spans:
+        begins.pop((r.get('pid'), r.get('id')), None)
+
+    by_name = {}
+    for r in spans:
+        st = by_name.setdefault(r.get('name', '?'),
+                                {'count': 0, 'total_s': 0.0,
+                                 'max_s': 0.0, 'errors': 0})
+        d = float(r.get('dur', 0.0))
+        st['count'] += 1
+        st['total_s'] += d
+        st['max_s'] = max(st['max_s'], d)
+        if not r.get('ok', True):
+            st['errors'] += 1
+    for st in by_name.values():
+        st['total_s'] = round(st['total_s'], 6)
+        st['max_s'] = round(st['max_s'], 6)
+        st['mean_s'] = round(st['total_s'] / st['count'], 6)
+
+    phases = [{'name': r.get('name', '?'), 'ts': r.get('ts'),
+               'dur_s': round(float(r.get('dur', 0.0)), 6),
+               'ok': r.get('ok', True),
+               **({'attrs': r['attrs']} if r.get('attrs') else {})}
+              for r in spans if r.get('depth', 0) == 0]
+    phases.sort(key=lambda p: p['ts'] or 0)
+
+    wall = 0.0
+    if spans:
+        t0 = min(float(r.get('ts', 0.0)) for r in spans)
+        t1 = max(float(r.get('ts', 0.0)) + float(r.get('dur', 0.0))
+                 for r in spans)
+        wall = round(t1 - t0, 6)
+
+    if registry is None:
+        from .metrics import REGISTRY
+        registry = REGISTRY
+    metrics = registry if isinstance(registry, dict) \
+        else registry.snapshot()
+
+    return {
+        'generated_at': time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                      time.gmtime()),
+        'nspans': len(spans),
+        'torn_lines': bad,
+        # begins with no matching end: what was IN FLIGHT at death
+        'unfinished': [{'name': b.get('name', '?'), 'ts': b.get('ts'),
+                        'depth': b.get('depth', 0)}
+                       for b in begins.values()],
+        'wall_s': wall,
+        'phases': phases,
+        'spans': {k: by_name[k] for k in sorted(by_name)},
+        'top': sorted(by_name, key=lambda k: -by_name[k]['total_s'])[:20],
+        'metrics': metrics,
+    }
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return '%.6g' % v
+    return str(v)
+
+
+def render_text(summary):
+    """The summary as an aligned plain-text report."""
+    out = []
+    w = out.append
+    w('== nbodykit_tpu diagnostics report ==')
+    w('generated: %s   spans: %d   wall: %.3f s'
+      % (summary.get('generated_at'), summary.get('nspans', 0),
+         summary.get('wall_s', 0.0)))
+    if summary.get('torn_lines'):
+        w('torn trace lines tolerated: %d (killed writer)'
+          % summary['torn_lines'])
+    if summary.get('unfinished'):
+        w('-- in flight at end of trace (no close event) --')
+        for b in summary['unfinished']:
+            w('  %s%s' % ('  ' * b.get('depth', 0), b['name']))
+
+    phases = summary.get('phases', [])
+    if phases:
+        w('-- phases (top-level spans) --')
+        nw = max(len(p['name']) for p in phases)
+        for p in phases:
+            flag = '' if p.get('ok', True) else '  [FAILED]'
+            w('  %-*s  %10.4f s%s' % (nw, p['name'], p['dur_s'], flag))
+
+    spans = summary.get('spans', {})
+    top = summary.get('top', [])
+    if top:
+        w('-- top spans by total time --')
+        nw = max(len(n) for n in top)
+        w('  %-*s  %7s  %12s  %12s  %12s' % (nw, 'name', 'count',
+                                             'total_s', 'mean_s',
+                                             'max_s'))
+        for n in top:
+            st = spans[n]
+            err = '  errors=%d' % st['errors'] if st.get('errors') else ''
+            w('  %-*s  %7d  %12.4f  %12.6f  %12.6f%s'
+              % (nw, n, st['count'], st['total_s'], st['mean_s'],
+                 st['max_s'], err))
+
+    metrics = summary.get('metrics', {})
+    if metrics:
+        w('-- metrics --')
+        nw = max(len(n) for n in metrics)
+        for name in sorted(metrics):
+            m = metrics[name]
+            t = m.get('type')
+            if t == 'counter':
+                body = _fmt(m.get('value'))
+            elif t == 'gauge':
+                body = '%s (min %s, max %s)' % (
+                    _fmt(m.get('value')), _fmt(m.get('min')),
+                    _fmt(m.get('max')))
+            else:
+                body = ('n=%d mean=%s min=%s max=%s last=%s'
+                        % (m.get('count', 0), _fmt(m.get('mean')),
+                           _fmt(m.get('min')), _fmt(m.get('max')),
+                           _fmt(m.get('last'))))
+            w('  %-*s  %s' % (nw, name, body))
+    return '\n'.join(out) + '\n'
+
+
+def write_report(path=None, tracer=None, registry=None):
+    """Write ``report.json`` + ``report.txt`` (atomic) summarizing the
+    active (or given) tracer's file plus the metric registry.
+
+    ``path``: directory to write into; defaults to the tracer's
+    directory.  Returns ``(json_path, txt_path)`` or ``None`` when
+    there is neither a tracer nor a path to report into.
+    """
+    tr = tracer if tracer is not None else current_tracer()
+    if path is None:
+        if tr is None:
+            return None
+        path = tr.dir
+    src = tr.path if tr is not None and os.path.exists(tr.path) \
+        else (path if os.path.exists(path) else None)
+    summary = summarize(registry=registry, trace_path=src)
+    os.makedirs(path, exist_ok=True)
+    jpath = os.path.join(path, 'report.json')
+    tpath = os.path.join(path, 'report.txt')
+    atomic_write(jpath, json.dumps(summary, indent=1, default=str))
+    atomic_write(tpath, render_text(summary))
+    return jpath, tpath

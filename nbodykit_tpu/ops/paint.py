@@ -24,6 +24,11 @@ import jax
 import jax.numpy as jnp
 
 from .window import window_weights, window_support
+# '.trace.' metrics below are bumped once per COMPILATION of the
+# enclosing program (these kernels run inside jit/shard_map), not per
+# execution — they document which kernel got traced at what size, not
+# how often it ran (see diagnostics/metrics.py)
+from ..diagnostics import counter, gauge
 
 # default cap on the mxu paint's per-piece one-hot Z expansion; shared
 # with pmesh.memory_plan so the estimate tracks the kernel
@@ -90,6 +95,8 @@ def paint_local(pos, mass, shape, resampler='cic', period=None, origin=0,
     flat = jnp.zeros(n0l * N1 * N2, dtype=dtype) if out is None \
         else jnp.asarray(out).reshape(-1)
 
+    counter('paint.trace.scatter').add(1)
+    counter('paint.trace.scatter_particles').add(int(n))
     mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
 
     def body(pos_c, mass_c, flat):
@@ -134,6 +141,8 @@ def readout_local(block, pos, resampler='cic', period=None, origin=0,
     period = tuple(int(p) for p in period)
     n = pos.shape[0]
     flat = block.reshape(-1)
+    counter('paint.trace.readout').add(1)
+    counter('paint.trace.readout_particles').add(int(n))
 
     def body(pos_c):
         vals = jnp.zeros(pos_c.shape[0], dtype=block.dtype)
@@ -185,6 +194,8 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     s = window_support(resampler)
     dtype = out.dtype if out is not None else (
         mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
+    counter('paint.trace.sort').add(1)
+    counter('paint.trace.sort_particles').add(int(n))
     mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
 
     # ONE sort, of the n base cells (not the s^3*n deposit terms): for
@@ -496,6 +507,12 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
     ck = max(8, -(-Kcap // npieces))
     ck = -(-ck // 8) * 8
     Kcap = npieces * ck              # pieces tile Kcap exactly
+
+    counter('paint.trace.mxu').add(1)
+    counter('paint.trace.mxu_particles').add(int(n))
+    gauge('paint.mxu.buckets').set(int(B))
+    gauge('paint.mxu.kcap').set(int(Kcap))
+    gauge('paint.mxu.pieces').set(int(npieces))
 
     src, overflow = _bucket_by_argsort(key, n, B, Kcap,
                                        order_method=order_method)

@@ -52,7 +52,9 @@ def _rank_kernel(dig_ref, rank_ref, hist_ref, base_ref, *, D, C):
     rank_in = (cumO * O).sum(axis=1).astype(jnp.int32) - 1
     base = base_ref[0, :]
     base_pick = jnp.where(eq, base[None, :], 0).sum(axis=1)
-    rank_ref[...] = (rank_in + base_pick)[None, :]
+    # explicit i32: under x64 the where/sum chain can promote to i64,
+    # and a pallas ref swap requires the exact ref dtype
+    rank_ref[...] = (rank_in + base_pick).astype(jnp.int32)[None, :]
     base = base + cumO[C - 1].astype(jnp.int32)
     base_ref[...] = base[None, :]
     hist_ref[...] = base[None, :]

@@ -30,6 +30,7 @@ the double-count weights for the missing half-plane are handled at binning
 time (see meshtools.py, mirroring reference nbodykit/meshtools.py:188-215).
 """
 
+import time as _time
 from functools import lru_cache as _lru_cache
 
 import jax
@@ -37,11 +38,29 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .runtime import AXIS, mesh_size
+from ..diagnostics import counter, current_tracer, histogram, span, \
+    span_if
 
 
 def _fft_chunk_bytes():
     from .. import _global_options
     return int(_global_options['fft_chunk_bytes'])
+
+
+def _lowmem_step(emit, upd, slab, buf, arr, k, r, stage):
+    """One eager chunk of a lowmem pass, optionally wrapped in an
+    ``fft.chunk`` span + wall histogram.  The per-chunk wall is
+    *dispatch* time (the stage programs are async); stalls show up on
+    the chunks that fill the dispatch queue, and the enclosing
+    ``fft.lowmem.*`` span has the true total."""
+    idx = jnp.int32(k * r)
+    if not emit:
+        return upd(buf, slab(arr, idx), idx)
+    t0 = _time.perf_counter()
+    with span('fft.chunk', stage=stage, index=k, rows=r):
+        buf = upd(buf, slab(arr, idx), idx)
+    histogram('fft.chunk_wall_s').observe(_time.perf_counter() - t0)
+    return buf
 
 
 def _chunk_rows(n, bytes_per_row, target):
@@ -79,20 +98,24 @@ def rfftn_single_lowmem(x_box, norm=None, target=None):
     r0, r1, zeros_y, zeros_out, slab_a, upd_a, slab_b, upd_b = progs
     N0, N1, _ = x.shape
 
-    # pass A: rfft along z + fft along y, slab-chunked over x rows;
-    # y is donated through every chunk call -> updated in place
-    y = zeros_y()
-    for i in range(N0 // r0):
-        idx = jnp.int32(i * r0)
-        y = upd_a(y, slab_a(x, idx), idx)
-    del x  # input freed before pass B allocates its output
+    emit = current_tracer() is not None
+    counter('fft.chunks').add(N0 // r0 + N1 // r1)
+    with span_if(emit, 'fft.lowmem.r2c', shape=[int(N0), int(N1)],
+                 chunks=[N0 // r0, N1 // r1]):
+        # pass A: rfft along z + fft along y, slab-chunked over x rows;
+        # y is donated through every chunk call -> updated in place
+        y = zeros_y()
+        for i in range(N0 // r0):
+            y = _lowmem_step(emit, upd_a, slab_a, y, x, i, r0,
+                             'r2c.rfftz_ffty')
+        del x  # input freed before pass B allocates its output
 
-    # pass B: fft along x, chunked over y columns, written transposed
-    out = zeros_out()
-    for j in range(N1 // r1):
-        jdx = jnp.int32(j * r1)
-        out = upd_b(out, slab_b(y, jdx), jdx)
-    return out
+        # pass B: fft along x, chunked over y columns, written transposed
+        out = zeros_out()
+        for j in range(N1 // r1):
+            out = _lowmem_step(emit, upd_b, slab_b, out, y, j, r1,
+                               'r2c.fftx')
+        return out
 
 
 def irfftn_single_lowmem(y_box, Nmesh2, norm=None, target=None):
@@ -107,19 +130,23 @@ def irfftn_single_lowmem(y_box, Nmesh2, norm=None, target=None):
     r1, r0, zeros_z, zeros_out, slab_a, upd_a, slab_b, upd_b = progs
     N1, N0, _ = y.shape
 
-    # pass A: undo the x-axis fft, chunked over ky rows (in-place)
-    z = zeros_z()
-    for j in range(N1 // r1):
-        jdx = jnp.int32(j * r1)
-        z = upd_a(z, slab_a(y, jdx), jdx)
-    del y
+    emit = current_tracer() is not None
+    counter('fft.chunks').add(N1 // r1 + N0 // r0)
+    with span_if(emit, 'fft.lowmem.c2r', shape=[int(N1), int(N0)],
+                 chunks=[N1 // r1, N0 // r0]):
+        # pass A: undo the x-axis fft, chunked over ky rows (in-place)
+        z = zeros_z()
+        for j in range(N1 // r1):
+            z = _lowmem_step(emit, upd_a, slab_a, z, y, j, r1,
+                             'c2r.ifftx')
+        del y
 
-    # pass B: ifft over ky + irfft over kz, chunked over x rows
-    out = zeros_out()
-    for i in range(N0 // r0):
-        idx = jnp.int32(i * r0)
-        out = upd_b(out, slab_b(z, idx), idx)
-    return out
+        # pass B: ifft over ky + irfft over kz, chunked over x rows
+        out = zeros_out()
+        for i in range(N0 // r0):
+            out = _lowmem_step(emit, upd_b, slab_b, out, z, i, r0,
+                               'c2r.iffty_irfftz')
+        return out
 
 
 @_lru_cache(maxsize=16)
@@ -228,6 +255,9 @@ def _rfftn_single_chunked(x, norm, target):
 
     # pass A: rfft along z + fft along y, slab-chunked over x
     r0 = _chunk_rows(N0, N1 * Nc * csz, op_target)
+    # '.trace.': bumped once per compilation of this program, not per
+    # execution (the loop is in-graph; see diagnostics/metrics.py)
+    counter('fft.trace.chunks').add(N0 // r0)
     y = jnp.zeros((N0, N1, Nc), cdt)
 
     def body_a(i, y):
@@ -299,6 +329,13 @@ def dist_rfftn(x, mesh=None, norm=None):
     -------
     jax.Array, global shape (N1, N0, N2//2 + 1), complex, sharded on axis 0.
     """
+    with span_if(not isinstance(x, jax.core.Tracer), 'fft.r2c',
+                 nproc=mesh_size(mesh),
+                 shape=[int(s) for s in x.shape]):
+        return _dist_rfftn_impl(x, mesh, norm)
+
+
+def _dist_rfftn_impl(x, mesh, norm):
     nproc = mesh_size(mesh)
     if nproc == 1:
         N0, N1, N2 = x.shape
@@ -350,6 +387,13 @@ def dist_irfftn(y, Nmesh2, mesh=None, norm=None):
     -------
     jax.Array, global shape (N0, N1, N2), real, sharded on axis 0.
     """
+    with span_if(not isinstance(y, jax.core.Tracer), 'fft.c2r',
+                 nproc=mesh_size(mesh),
+                 shape=[int(s) for s in y.shape]):
+        return _dist_irfftn_impl(y, Nmesh2, mesh, norm)
+
+
+def _dist_irfftn_impl(y, Nmesh2, mesh, norm):
     nproc = mesh_size(mesh)
     if nproc == 1:
         target = _fft_chunk_bytes()
@@ -440,6 +484,13 @@ def dist_fftn_c2c(x, mesh=None, inverse=False, norm=None):
     transposed. Inverse: the reverse. Used by the white-noise generator
     and ConvolvedFFTPower's Ylm products where a c2c view is simpler.
     """
+    with span_if(not isinstance(x, jax.core.Tracer), 'fft.c2c',
+                 nproc=mesh_size(mesh), inverse=bool(inverse),
+                 shape=[int(s) for s in x.shape]):
+        return _dist_fftn_c2c_impl(x, mesh, inverse, norm)
+
+
+def _dist_fftn_c2c_impl(x, mesh, inverse, norm):
     nproc = mesh_size(mesh)
     fft = jnp.fft.ifft if inverse else jnp.fft.fft
     if nproc == 1:

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .runtime import AXIS, mesh_size
+from ..diagnostics import counter, gauge, span_if
 
 
 def counted_capacity(pm_or_nproc, pos_or_dest, slack=1.05, n0=None):
@@ -207,6 +208,19 @@ def exchange_by_dest(dest, arrays, mesh, capacity=None, fill=0.0):
 
     payloads = [live] + list(arrays)
 
+    # telemetry: the all_to_all buffer volume is shape-derived (static),
+    # so the counters are exact even when this runs under a trace —
+    # bytes_sent == bytes_received is the global (P, P, capacity)
+    # buffer footprint actually shipped, the number the counted
+    # exchange exists to shrink (~N/P^2 vs the ceil(N/P) bound)
+    xbytes = int(sum(
+        nproc * nproc * int(capacity)
+        * int(np.prod(a.shape[1:], dtype=np.int64))
+        * jnp.dtype(a.dtype).itemsize for a in payloads))
+    counter('exchange.calls').add(1)
+    counter('exchange.bytes_sent').add(xbytes)
+    gauge('exchange.capacity').set(int(capacity))
+
     def local(dest_l, *payloads_l):
         # payloads_l[0] is the live mask: pad entries that overflow a
         # bucket are not real losses
@@ -227,8 +241,11 @@ def exchange_by_dest(dest, arrays, mesh, capacity=None, fill=0.0):
         P(*((AXIS,) + (None,) * (a.ndim - 1))) for a in payloads)
     out_specs = (P(AXIS), P()) + tuple(
         P(*((AXIS,) + (None,) * (a.ndim - 1))) for a in payloads)
-    res = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
-                        out_specs=out_specs)(dest, *payloads)
+    with span_if(not isinstance(dest, jax.core.Tracer), 'exchange',
+                 nproc=nproc, capacity=int(capacity), bytes=xbytes,
+                 npart=int(n)):
+        res = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs)(dest, *payloads)
     slot_valid, dropped, live_recv = res[0], res[1], res[2]
     valid = slot_valid & live_recv
     return list(res[3:]), valid, dropped

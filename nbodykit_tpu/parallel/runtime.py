@@ -54,10 +54,14 @@ def init_distributed(coordinator_address=None, num_processes=None,
         process_id = int(pid) if pid is not None else None
     if coordinator_address is None and num_processes is None:
         return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id,
-        local_device_ids=local_device_ids)
+    from ..diagnostics import span
+    with span('runtime.init_distributed',
+              coordinator=str(coordinator_address),
+              num_processes=num_processes, process_id=process_id):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            local_device_ids=local_device_ids)
     return True
 
 
@@ -198,11 +202,22 @@ def shard_leading(mesh, arr):
     if arr.shape[0] % n:
         return arr
     spec = (AXIS,) + (None,) * (arr.ndim - 1)
-    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+    from ..diagnostics import counter, span_if
+    eager = not isinstance(arr, jax.core.Tracer)
+    nbytes = int(getattr(arr, 'nbytes', 0) or 0)
+    if eager:
+        counter('runtime.device_put_bytes').add(nbytes)
+    with span_if(eager and nbytes > (1 << 20), 'runtime.shard_leading',
+                 bytes=nbytes):
+        return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
 
 
 def replicate(mesh, arr):
     """Place an array fully replicated over the mesh."""
     if mesh is None:
         return arr
+    from ..diagnostics import counter
+    if not isinstance(arr, jax.core.Tracer):
+        counter('runtime.device_put_bytes').add(
+            int(getattr(arr, 'nbytes', 0) or 0))
     return jax.device_put(arr, NamedSharding(mesh, P()))

@@ -23,6 +23,7 @@ wrappers in :mod:`nbodykit_tpu.base.mesh` add attrs/convenience methods.
 """
 
 import logging
+import time
 
 import numpy as np
 import jax
@@ -30,6 +31,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import _global_options
+from .diagnostics import current_tracer, histogram, span, \
+    trace_state_clean
 from .parallel.runtime import AXIS, CurrentMesh, mesh_size, shard_leading
 from .parallel import dfft
 from .parallel.halo import halo_add, halo_fill
@@ -270,7 +273,35 @@ class ParticleMesh(object):
         with doubled capacity until nothing drops; under a trace the
         check cannot branch, so ``return_dropped=True`` is REQUIRED —
         silent particle loss is never possible.
+
+        Diagnostics (docs/OBSERVABILITY.md): eager calls with the
+        ``diagnostics`` option set emit a ``paint`` span and record the
+        per-method throughput histogram ``paint.<method>.mpart_per_s``.
+        The result is synced (``block_until_ready``) inside the span so
+        the throughput is real work, not dispatch — enabled-mode only;
+        the disabled path is byte-identical to the undiagnosed one.
         """
+        if current_tracer() is None or not trace_state_clean():
+            return self._paint_impl(pos, mass, resampler, out, shift,
+                                    capacity, return_dropped)
+        method = _global_options['paint_method']
+        npart = int(pos.shape[0])
+        t0 = time.perf_counter()
+        with span('paint', method=method, npart=npart,
+                  nproc=self.nproc,
+                  resampler=resampler or _global_options['resampler'],
+                  nmesh=int(self.Nmesh[0])):
+            res = self._paint_impl(pos, mass, resampler, out, shift,
+                                   capacity, return_dropped)
+            jax.block_until_ready(res)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        histogram('paint.%s.wall_s' % method).observe(dt)
+        histogram('paint.%s.mpart_per_s' % method).observe(
+            npart / dt / 1e6)
+        return res
+
+    def _paint_impl(self, pos, mass, resampler, out, shift, capacity,
+                    return_dropped):
         resampler = resampler or _global_options['resampler']
         h = window_support(resampler)
         N0, N1, N2 = self.shape_real
@@ -427,8 +458,25 @@ class ParticleMesh(object):
         algorithms/fftrecon.py:217-268).
 
         ``capacity``/``return_dropped`` follow the same overflow
-        contract as :meth:`paint`.
+        contract as :meth:`paint`; eager calls emit a ``readout`` span
+        under diagnostics (same sync semantics as :meth:`paint`).
         """
+        if current_tracer() is None or not trace_state_clean():
+            return self._readout_impl(real, pos, resampler, capacity,
+                                      return_dropped)
+        npart = int(pos.shape[0])
+        t0 = time.perf_counter()
+        with span('readout', npart=npart, nproc=self.nproc,
+                  nmesh=int(self.Nmesh[0])):
+            res = self._readout_impl(real, pos, resampler, capacity,
+                                     return_dropped)
+            jax.block_until_ready(res)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        histogram('readout.mpart_per_s').observe(npart / dt / 1e6)
+        return res
+
+    def _readout_impl(self, real, pos, resampler, capacity,
+                      return_dropped):
         resampler = resampler or _global_options['resampler']
         h = window_support(resampler)
         N0, N1, N2 = self.shape_real

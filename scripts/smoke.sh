@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Smoke check: the diagnostics self-check (round-trips a trace file,
+# including a simulated killed writer) plus the tier-1 fast subset of
+# the suites covering the instrumented hot paths.  Intended as the
+# cheap pre-push / CI gate; the full fast tier is ROADMAP.md's tier-1
+# command.
+#
+#   scripts/smoke.sh            # default fast subset (~2-3 min warm)
+#   SMOKE_PYTEST_ARGS='-x -k paint' scripts/smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== diagnostics self-check =="
+python -m nbodykit_tpu.diagnostics --self-check
+
+echo "== tier-1 fast subset =="
+python -m pytest \
+    tests/test_diagnostics.py \
+    tests/test_pmesh.py \
+    tests/test_fftpower.py \
+    tests/test_counted_exchange.py \
+    tests/test_radix.py \
+    -q -m 'not slow' -p no:cacheprovider ${SMOKE_PYTEST_ARGS:-}
+
+echo "smoke OK"

@@ -17,10 +17,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+from nbodykit_tpu._jax_compat import set_cpu_devices  # noqa: E402
+
+set_cpu_devices(4)
 
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
+
+# every worker leaves a post-mortem span trace: multi-host failures are
+# the recurring blind spot (a hung/killed worker used to leave nothing
+# but a truncated stdout).  Per-process files (trace-<pid>.jsonl) under
+# one directory; NBKIT_DIAGNOSTICS overrides the location, an empty
+# value disables.  Read back with
+# ``python -m nbodykit_tpu.diagnostics --report <dir>``.
+from nbodykit_tpu import diagnostics  # noqa: E402
+
+_TRACE_DIR = os.environ.get('NBKIT_DIAGNOSTICS',
+                            '/tmp/nbodykit-tpu-multihost-trace')
+if _TRACE_DIR:
+    diagnostics.configure(_TRACE_DIR)
 
 
 def main():
@@ -33,29 +48,31 @@ def main():
                                 num_processes=nprocs, process_id=pid)
     if mode == 'batch':
         return main_batch()
-    mesh = world_mesh()
-    ndev = len(jax.devices())
+    with diagnostics.span('multihost.pipeline', nprocs=nprocs,
+                          proc=pid):
+        mesh = world_mesh()
+        ndev = len(jax.devices())
 
-    from nbodykit_tpu.pmesh import ParticleMesh
-    pm = ParticleMesh(Nmesh=16, BoxSize=50.0, dtype='f4', comm=mesh)
+        from nbodykit_tpu.pmesh import ParticleMesh
+        pm = ParticleMesh(Nmesh=16, BoxSize=50.0, dtype='f4', comm=mesh)
 
-    N = 4096
-    pos_np = np.random.RandomState(7).uniform(0, 50.0, (N, 3)) \
-        .astype('f4')
+        N = 4096
+        pos_np = np.random.RandomState(7).uniform(0, 50.0, (N, 3)) \
+            .astype('f4')
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from nbodykit_tpu.parallel.runtime import AXIS
-    sharding = NamedSharding(mesh, P(AXIS, None))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from nbodykit_tpu.parallel.runtime import AXIS
+        sharding = NamedSharding(mesh, P(AXIS, None))
 
-    def cb(index):
-        return pos_np[index]
+        def cb(index):
+            return pos_np[index]
 
-    pos = jax.make_array_from_callback((N, 3), sharding, cb)
+        pos = jax.make_array_from_callback((N, 3), sharding, cb)
 
-    field = pm.paint(pos, 1.0, resampler='cic')
-    total = float(jnp.sum(field.astype(jnp.float32)))
-    c = pm.r2c(field)
-    p2 = float(jnp.sum(jnp.abs(c) ** 2))
+        field = pm.paint(pos, 1.0, resampler='cic')
+        total = float(jnp.sum(field.astype(jnp.float32)))
+        c = pm.r2c(field)
+        p2 = float(jnp.sum(jnp.abs(c) ** 2))
     print("RESULT %d %.6e %.6e" % (ndev, total, p2), flush=True)
 
 
@@ -77,8 +94,9 @@ def main_batch():
         field = pm.paint(pos, 1.0, resampler='cic')
         return round(float(jnp.sum(field.astype(jnp.float32))), 3)
 
-    with TaskManager(cpus_per_task=4) as tm:
-        results = tm.map(work, list(range(11, 16)))
+    with diagnostics.span('multihost.batch'):
+        with TaskManager(cpus_per_task=4) as tm:
+            results = tm.map(work, list(range(11, 16)))
     print("BATCHRESULT %s" % ",".join("%.3f" % r for r in results),
           flush=True)
 

@@ -12,6 +12,10 @@ way to get 8 CPU devices + CPU default + x64.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import jax
 import numpy as np  # noqa: F401
@@ -20,7 +24,12 @@ import pytest
 # cpu-only: keeps the (possibly unreachable) axon TPU backend from even
 # initializing — jax.devices() would otherwise block on its tunnel
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# 8 virtual devices, robust to jax versions without the
+# jax_num_cpu_devices config (falls back to XLA_FLAGS, which works
+# because no backend has initialized yet)
+from nbodykit_tpu._jax_compat import set_cpu_devices  # noqa: E402
+
+set_cpu_devices(8)
 jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compile cache: the suite is compile-dominated on this

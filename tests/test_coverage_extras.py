@@ -257,7 +257,15 @@ def test_fftcorr_matches_paircount_xi():
 def test_ylm_cache_complex_parity():
     """YlmCache returns complex Y_lm matching scipy's sph_harm_y
     (reference: sympy-backed YlmCache, threeptcf.py:393-505)."""
-    from scipy.special import sph_harm_y
+    try:
+        from scipy.special import sph_harm_y
+    except ImportError:    # scipy < 1.15: the old spelling/arg order
+        from scipy.special import sph_harm
+
+        def sph_harm_y(n, m, theta, phi):
+            # sph_harm(m, n, azimuth, polar) == sph_harm_y(n, m,
+            # polar, azimuth)
+            return sph_harm(m, n, phi, theta)
     from nbodykit_tpu.lab import YlmCache
 
     cache = YlmCache([0, 1, 2, 3, 4, 5])
