@@ -90,6 +90,19 @@ TPU_PLATFORMS = ('tpu', 'axon')
 V5E_HBM_GBPS = 819.0
 
 
+def _utcnow():
+    return time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())
+
+
+def _stamp(rec):
+    """Every emitted record carries the measurement's REAL timestamp:
+    the regression tracker (nbodykit_tpu.diagnostics.regress) judges
+    evidence freshness from it, so a replayed number can never pass as
+    a fresh one just because it was printed today."""
+    rec.setdefault('measured_at', _utcnow())
+    return rec
+
+
 def _setup_jax():
     """Import jax, honoring an explicit cpu request the way
     __graft_entry__.py does (the sitecustomize overrides JAX_PLATFORMS/
@@ -352,6 +365,7 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     nbodykit_tpu.set_options(paint_method=method, paint_order='auto',
                              paint_deposit='auto')
     from nbodykit_tpu.diagnostics import span as _span
+    from nbodykit_tpu.diagnostics import instrumented_jit as _ijit
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     with _span('bench.make_pos', npart=Npart, nmesh=Nmesh):
         pos = _make_pos(jax, jnp, Npart, 1000.0)
@@ -378,7 +392,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     if not staged:
         try:
             dt, compile_s = _time_fn(
-                jax, jax.jit(fused), (pos,), reps, label='fused',
+                jax, _ijit(fused, label='bench.fused'), (pos,), reps,
+                label='fused',
                 on_warm=lambda cs: _stage_partial(
                     rec, partial=True, stage='warmed', mode='fused',
                     first_run_s=round(cs, 4)))
@@ -395,8 +410,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
             staged = True
     if staged:
         rec['mode'] = 'staged'
-        s_paint = jax.jit(lambda p: phase_fns['paint'](p)
-                          / (Npart / pm.Ntot))
+        s_paint = _ijit(lambda p: phase_fns['paint'](p)
+                        / (Npart / pm.Ntot), label='bench.paint')
         # donate every inter-stage buffer: at Nmesh=1024 the real field
         # is ~4.3 GB and the staged peak is workspace-bound (see
         # pmesh.memory_plan) — reusing the input buffers is the
@@ -405,7 +420,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         # (field + two c64 mesh buffers + p3 live in one program), so
         # the FFT and the compensate+|c|^2 run as separate donated jits
         # — each then holds at most ~3 full-mesh buffers.
-        s_bin = jax.jit(phase_fns['binning'], donate_argnums=0)
+        s_bin = _ijit(phase_fns['binning'], label='bench.binning',
+                      donate_argnums=0)
         if Nmesh >= 1024:
             # the in-jit chunked FFT double-buffers its loop carries
             # (~4 full-mesh buffers — over HBM next to the particles),
@@ -417,9 +433,9 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
             # the lowmem driver bypasses pm.r2c, so its forward
             # normalization (pmesh convention, pmesh.py::r2c) is
             # applied here before the shared power tail
-            s_cpow = jax.jit(
+            s_cpow = _ijit(
                 lambda c: phase_fns['comp_pow'](c * (1.0 / pm.Ntot)),
-                donate_argnums=0)
+                label='bench.comp_pow', donate_argnums=0)
 
             def paint_fft():
                 # the one-element box is built HERE so no caller stack
@@ -433,7 +449,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
             def run_once():
                 return s_bin(s_cpow(paint_fft()))
         else:
-            s_power = jax.jit(phase_fns['field_power'], donate_argnums=0)
+            s_power = _ijit(phase_fns['field_power'],
+                            label='bench.field_power', donate_argnums=0)
             run_once = lambda: s_bin(s_power(s_paint(pos)))
         with _span('bench.warmup', label='staged'):
             t0 = time.time()
@@ -449,6 +466,7 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
                 _sync(jax, run_once())
         dt = (time.time() - t0) / reps
     rec.update(value=round(dt, 4), compile_s=round(compile_s, 1))
+    _stamp(rec)
     _stage_partial(rec, partial=False, stage='complete')
     _attach_baseline(rec)
 
@@ -605,7 +623,7 @@ def run_fkp(Nmesh=512, nbar=1e-4, reps=1):
                 np.max(np.abs(got - ref) / np.maximum(np.abs(ref), 1e-30)))
         except (OSError, KeyError, ValueError):
             pass
-    return rec
+    return _stamp(rec)
 
 
 def run_prim(n=10_000_000, reps=3):
@@ -673,8 +691,8 @@ def run_prim(n=10_000_000, reps=3):
           lambda k: pass_rank_hist_pallas(k % 130, 130)[0], small)
     except Exception as e:          # lowering/import failure is itself
         out['radix_rank_pallas_D130'] = {"error": str(e)[:200]}  # data
-    return {"metric": "prim_microbench_n%.0e" % n, "n": n,
-            "platform": jax.devices()[0].platform, "prims": out}
+    return _stamp({"metric": "prim_microbench_n%.0e" % n, "n": n,
+                   "platform": jax.devices()[0].platform, "prims": out})
 
 
 def run_fftbw(Nmesh=512, reps=3):
@@ -735,7 +753,7 @@ def run_fftbw(Nmesh=512, reps=3):
     # ~6 field passes across the three axis stages (transposed layout)
     rec['value'] = round(6 * field_bytes / dt / 1e9, 1)
     rec['frac_hbm_peak'] = round(rec['value'] / V5E_HBM_GBPS, 3)
-    return rec
+    return _stamp(rec)
 
 
 def run_paint(Nmesh, Npart, method='scatter', reps=3):
@@ -767,13 +785,13 @@ def run_paint(Nmesh, Npart, method='scatter', reps=3):
                                     return_dropped=True)[0])
     dt, _ = _time_fn(jax, fn, (pos,), reps,
                      label='paint_%s' % method_label)
-    return {
+    return _stamp({
         "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"
                   % (Nmesh, Npart, method_label),
         "value": round(dt, 4), "unit": "s",
         "mpart_per_s": round(Npart / dt / 1e6, 1),
         "platform": jax.devices()[0].platform,
-    }
+    })
 
 
 # ---------------------------------------------------------------------------
@@ -827,8 +845,7 @@ def _cache_tpu_result(rec):
     except (OSError, ValueError):
         cache = {"results": {}}
     rec = dict(rec)
-    rec['measured_at'] = time.strftime('%Y-%m-%dT%H:%M:%SZ',
-                                       time.gmtime())
+    _stamp(rec)     # keep the original measurement time on re-cache
     if rec.get('error'):
         return  # an error-flagged timing must never become a headline
     prev = cache['results'].get(rec['metric'])
@@ -869,8 +886,7 @@ def _cache_cpu_baseline(rec):
         # the core would otherwise inflate vs_baseline in our favor
         return
     rec = dict(rec)
-    rec['measured_at'] = time.strftime('%Y-%m-%dT%H:%M:%SZ',
-                                       time.gmtime())
+    _stamp(rec)     # keep the original measurement time on re-cache
     data['results'][rec['metric']] = rec
     tmp = path + '.tmp'
     with open(tmp, 'w') as f:
@@ -1209,6 +1225,18 @@ def main():
         out['platform'] = cached.get('platform')
         if cached.get('baseline_source'):
             out['baseline_source'] = cached['baseline_source']
+        # a replay is marked as such in machine-readable form: the
+        # regression tracker verdicts any replay older than its stale
+        # bar, and the counter makes replays visible in the end-of-run
+        # report — round 5 shipped a 4-day-old cache number silently
+        out['measured_at'] = cached.get('measured_at')
+        from nbodykit_tpu.diagnostics import counter
+        from nbodykit_tpu.diagnostics.regress import parse_utc
+        ts = parse_utc(cached.get('measured_at'))
+        if ts is not None:
+            out['cache_age_hours'] = round((time.time() - ts) / 3600.0,
+                                           1)
+        counter('bench.cache_replay').add(1)
         out['note'] = ('live TPU run unavailable this invocation '
                        '(worker state: %s); reporting the most recent '
                        'real-TPU measurement, taken at %s UTC '

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from ..base.catalog import CatalogSourceBase
 from ..base.mesh import MeshSource, Field, FieldMesh
 from ..binned_statistic import BinnedStatistic
-from ..diagnostics import NULL_SPAN, span_eager
+from ..diagnostics import NULL_SPAN, instrumented_jit, span_eager
 from ..utils import JSONEncoder, JSONDecoder, as_numpy
 
 
@@ -298,12 +298,13 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
             hs = _block_hists(v_loc, base, varying=True)
             return tuple(jax.lax.psum(h, AXIS) for h in hs)
 
-        _bin = jax.jit(jax.shard_map(
+        _bin = instrumented_jit(jax.shard_map(
             _local, mesh=pm.comm,
             in_specs=(_P(AXIS, None, None),),
-            out_specs=(_P(),) * nstreams))
+            out_specs=(_P(),) * nstreams), label='fftpower.binning')
     else:
-        _bin = jax.jit(lambda v: tuple(_block_hists(v, 0)))
+        _bin = instrumented_jit(lambda v: tuple(_block_hists(v, 0)),
+                                label='fftpower.binning')
 
     _sp = span_eager('fftpower.binning', nstreams=nstreams,
                      shape=[int(s) for s in value.shape])
@@ -762,7 +763,8 @@ class ProjectedFFTPower(FFTBase):
 
         ksum, nsum, psum_re, psum_im = (
             np.asarray(a, dtype='f8') for a in
-            jax.jit(_pipeline)(f1.value, f2.value))
+            instrumented_jit(_pipeline, label='fftpower.projected')(
+                f1.value, f2.value))
 
         area = float(np.prod([self.attrs['BoxSize'][i] for i in axes]))
         power = np.empty(len(kedges) - 1, dtype=[

@@ -13,25 +13,40 @@ evidence (ISSUE #1 / round-5 verdict).  Three pieces:
   Perfetto/chrome-trace export.  No-op when disabled.
 - :mod:`.metrics` — process-wide counters/gauges/histograms (exchange
   bytes, FFT chunk walls, paint Mpart/s per kernel, device live-buffer
-  watermarks).
+  watermarks) plus compile telemetry (``instrumented_jit``, the
+  ``jax.monitoring`` hook).
 - :mod:`.report` — end-of-run summary (per-phase wall, top spans,
   metric tables) as JSON + text, written atomically.
+- :mod:`.analyze` — fleet-level analysis of a directory of per-process
+  traces: clock alignment on collective anchors, merged timeline,
+  straggler tables, critical-path attribution, hung-collective and
+  heartbeat post-mortems.
+- :mod:`.regress` — the BENCH_r*.json trajectory as machine-checked
+  history (``BENCH_HISTORY.json``): regression and stale-evidence
+  verdicts.
 
 Enable with ``nbodykit_tpu.set_options(diagnostics='/tmp/trace')`` (or
 ``$NBKIT_DIAGNOSTICS``); self-check with
-``python -m nbodykit_tpu.diagnostics --self-check``.  Full guide:
-docs/OBSERVABILITY.md.
+``python -m nbodykit_tpu.diagnostics --self-check``; fleet doctor with
+``nbodykit-tpu-doctor``.  Full guide: docs/OBSERVABILITY.md.
 """
 
 import functools
+import os
 
 from .trace import (NULL_SPAN, Tracer, atomic_write, current_tracer,  # noqa: F401
                     export_chrome_trace, read_trace, trace_files,
                     trace_state_clean)
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, counter, gauge, histogram,
-                      device_watermarks)
+                      device_watermarks, install_compile_telemetry,
+                      instrumented_jit)
 from .report import render_text, summarize, write_report  # noqa: F401
+# the function is re-exported as analyze_trace so the submodule
+# remains reachable as nbodykit_tpu.diagnostics.analyze
+from .analyze import analyze as analyze_trace  # noqa: F401
+from .analyze import render_analysis  # noqa: F401
+from .regress import build_history, render_regress  # noqa: F401
 
 
 def enabled():
@@ -48,6 +63,20 @@ def configure(path):
     from .. import _global_options
     _global_options['diagnostics'] = path
     return current_tracer()
+
+
+def configure_from_env(default=None, var='NBKIT_DIAGNOSTICS'):
+    """Resolve the trace destination from the environment and enable it.
+
+    The single place detached workers (bench ladder, multi-host test
+    workers) decide where to trace: ``$NBKIT_DIAGNOSTICS`` wins when
+    set (an empty value explicitly disables), else ``default``; None
+    disables.  Returns the active tracer (or None).
+    """
+    path = os.environ.get(var)
+    if path is None:
+        path = default
+    return configure(path or None)
 
 
 def span(name, **attrs):

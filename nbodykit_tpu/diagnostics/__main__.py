@@ -1,4 +1,6 @@
-"""Diagnostics CLI: self-check, post-mortem report, chrome export.
+"""Diagnostics CLI: self-check, post-mortem report, fleet analysis,
+bench regression tracking, chrome export — and the doctor that runs
+them all.
 
     python -m nbodykit_tpu.diagnostics --self-check
         Round-trip a trace file end to end: emit nested + failing
@@ -11,8 +13,25 @@
         Print the text report for an existing trace file/directory
         (e.g. from a dead TPU run).
 
+    python -m nbodykit_tpu.diagnostics --analyze DIR
+        Fleet analysis of a directory of per-process traces: merged
+        timeline with aligned clocks, per-collective straggler table,
+        critical-path breakdown, hung collectives, heartbeat gaps.
+
+    python -m nbodykit_tpu.diagnostics --regress [ROOT]
+        Build BENCH_HISTORY.json from the BENCH_r*.json /
+        BASELINE*.json / BENCH_TPU_CACHE.json family under ROOT
+        (default .) and print the verdicts.  Exits nonzero on a
+        malformed bench record (the smoke-gate contract); stale cache
+        replays and regressions warn loudly but do not block.
+
     python -m nbodykit_tpu.diagnostics --chrome PATH
         Export PATH to chrome_trace.json for ui.perfetto.dev.
+
+    python -m nbodykit_tpu.diagnostics --doctor [--trace DIR] [--root R]
+        Self-check + analyze + regress, one verdict block.  Installed
+        as the ``nbodykit-tpu-doctor`` console script;
+        ``--self-check-only`` restricts it to the trace round-trip.
 """
 
 import argparse
@@ -42,6 +61,10 @@ def self_check(path=None, verbose=True):
         with nbodykit_tpu.set_options(diagnostics=path):
             tr = current_tracer()
             assert tr is not None, 'tracer did not come up'
+            # deltas, not absolutes: the registry is process-global and
+            # the doctor may run the self-check more than once
+            c0 = counter('selfcheck.count').value
+            h0 = histogram('selfcheck.hist').count
             with span('selfcheck', kind='root'):
                 with span('selfcheck.child'):
                     counter('selfcheck.count').add(3)
@@ -80,8 +103,8 @@ def self_check(path=None, verbose=True):
             assert any(e['name'] == 'selfcheck' for e in events)
 
             snap = REGISTRY.snapshot()
-            assert snap['selfcheck.count']['value'] == 3
-            assert snap['selfcheck.hist']['count'] == 1
+            assert snap['selfcheck.count']['value'] == c0 + 3
+            assert snap['selfcheck.hist']['count'] == h0 + 1
 
             paths = write_report(tracer=tr)
             assert paths is not None
@@ -101,6 +124,130 @@ def self_check(path=None, verbose=True):
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_analyze(path, out=None):
+    """--analyze: print the fleet analysis; exit 0 unless the trace is
+    missing (2).  Hung collectives / silent processes are findings to
+    report, not tool failures."""
+    from .analyze import analyze, render_analysis
+    out = out if out is not None else sys.stdout
+    if not os.path.exists(path):
+        print('no such trace: %s' % path, file=sys.stderr)
+        return 2
+    out.write(render_analysis(analyze(path)))
+    return 0
+
+
+def run_regress(root, out=None, threshold=0.25,
+                stale_hours=24.0, write=True):
+    """--regress: build + print the bench history; the exit code is
+    the CI gate (nonzero only on malformed records)."""
+    from .regress import build_history, gate_rc, render_regress
+    out = out if out is not None else sys.stdout
+    history = build_history(root, threshold=threshold,
+                            stale_hours=stale_hours, write=write)
+    out.write(render_regress(history))
+    return gate_rc(history)
+
+
+def run_doctor(trace=None, root='.', self_check_only=False,
+               out=None, threshold=0.25, stale_hours=24.0):
+    """Self-check + analyze + regress, one verdict block.
+
+    Returns 0 (OK/WARN) or 1 (FAIL).  FAIL means the diagnostics stack
+    itself is broken, a trace shows a hung collective or silent
+    process, or a committed bench record is malformed.  WARN covers
+    stale replays and regressions — loud, but not blocking.
+    """
+    out = out if out is not None else sys.stdout
+    lines, fail, warn = [], [], []
+
+    try:
+        self_check(verbose=False)
+        lines.append('self-check   OK: trace round-trip, torn-line '
+                     'replay, report, chrome export')
+    except Exception as e:
+        fail.append('self-check')
+        lines.append('self-check   FAIL: %s' % e)
+
+    if self_check_only:
+        trace = None
+        root = None
+
+    if trace and os.path.exists(trace):
+        from .analyze import analyze
+        try:
+            res = analyze(trace)
+        except Exception as e:    # a broken trace must still report
+            res = None
+            fail.append('analyze')
+            lines.append('analyze      FAIL: %s' % e)
+        if res is not None and res.get('empty'):
+            lines.append('analyze      SKIP: no trace records under %s'
+                         % trace)
+        elif res is not None:
+            hung = res['hangs']['hung_collectives']
+            silent = [p for p, st in res['heartbeat'].items()
+                      if st.get('silent')]
+            skews = [st['max_skew_s'] for st in
+                     res['stragglers']['per_name'].values()]
+            desc = ('%d procs, %d spans, wall %.3f s, max skew %s'
+                    % (res['nprocs'], res['nspans'],
+                       res['critical_path']['wall_s'],
+                       '%.1f ms' % (max(skews) * 1e3) if skews
+                       else 'n/a'))
+            if hung or silent:
+                fail.append('analyze')
+                lines.append('analyze      FAIL: %s; %d hung '
+                             'collective(s), %d silent process(es) — '
+                             'run --analyze %s for the post-mortem'
+                             % (desc, len(hung), len(silent), trace))
+            else:
+                lines.append('analyze      OK: %s' % desc)
+    elif trace:
+        lines.append('analyze      SKIP: no trace at %s' % trace)
+    elif not self_check_only:
+        lines.append('analyze      SKIP: no trace directory (pass '
+                     '--trace DIR or set NBKIT_DIAGNOSTICS)')
+
+    if root is not None:
+        from .regress import build_history, render_regress
+        try:
+            history = build_history(root, threshold=threshold,
+                                    stale_hours=stale_hours)
+        except Exception as e:
+            history = None
+            fail.append('regress')
+            lines.append('regress      FAIL: %s' % e)
+        if history is not None:
+            s = history['summary']
+            desc = ('%d rounds: %s'
+                    % (len(history['rounds']),
+                       '  '.join('%s=%d' % (k, n)
+                                 for k, n in s.items() if n)
+                       or 'none found'))
+            if s.get('malformed'):
+                fail.append('regress')
+                lines.append('regress      FAIL: %s — malformed bench '
+                             'record(s)' % desc)
+            elif s.get('stale') or s.get('regression'):
+                warn.append('regress')
+                lines.append('regress      WARN: %s — stale replays / '
+                             'regressions are evidence to refresh, '
+                             'not results (see %s)'
+                             % (desc, history.get('path',
+                                                  'BENCH_HISTORY.json')))
+            else:
+                lines.append('regress      OK: %s' % desc)
+
+    verdict = 'FAIL (%s)' % ', '.join(fail) if fail else \
+        ('WARN (%s)' % ', '.join(warn) if warn else 'OK')
+    out.write('== nbodykit-tpu doctor ==\n')
+    for line in lines:
+        out.write(line + '\n')
+    out.write('VERDICT: %s\n' % verdict)
+    return 1 if fail else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='python -m nbodykit_tpu.diagnostics',
@@ -115,10 +262,43 @@ def main(argv=None):
     ap.add_argument('--report', metavar='TRACE',
                     help='print the text report for a trace '
                          'file/directory')
+    ap.add_argument('--analyze', metavar='TRACE',
+                    help='fleet analysis of a per-process trace '
+                         'directory: merged timeline, stragglers, '
+                         'critical path, hangs')
+    ap.add_argument('--regress', metavar='ROOT', nargs='?',
+                    const='.', default=None,
+                    help='build BENCH_HISTORY.json from the bench '
+                         'record family under ROOT (default .) and '
+                         'print verdicts; exits nonzero on malformed '
+                         'records')
+    ap.add_argument('--threshold', type=float, default=0.25,
+                    help='relative regression threshold for --regress '
+                         '/ --doctor (default 0.25)')
+    ap.add_argument('--stale-hours', type=float, default=24.0,
+                    help='cache-replay age beyond which a bench '
+                         'headline is verdicted stale (default 24)')
     ap.add_argument('--chrome', metavar='TRACE',
                     help='export a trace to chrome_trace.json')
+    ap.add_argument('--doctor', action='store_true',
+                    help='self-check + analyze + regress, one verdict '
+                         'block')
+    ap.add_argument('--trace', default=None,
+                    help='trace directory for --doctor (default: '
+                         '$NBKIT_DIAGNOSTICS)')
+    ap.add_argument('--root', default='.',
+                    help='bench-record root for --doctor (default .)')
+    ap.add_argument('--self-check-only', action='store_true',
+                    help='restrict --doctor to the self-check')
     args = ap.parse_args(argv)
 
+    if args.doctor or args.self_check_only:
+        trace = args.trace if args.trace is not None \
+            else os.environ.get('NBKIT_DIAGNOSTICS') or None
+        return run_doctor(trace=trace, root=args.root,
+                          self_check_only=args.self_check_only,
+                          threshold=args.threshold,
+                          stale_hours=args.stale_hours)
     if args.self_check:
         return self_check(args.path)
     if args.report:
@@ -128,6 +308,11 @@ def main(argv=None):
             return 2
         sys.stdout.write(render_text(summarize(trace_path=args.report)))
         return 0
+    if args.analyze:
+        return run_analyze(args.analyze)
+    if args.regress is not None:
+        return run_regress(args.regress, threshold=args.threshold,
+                           stale_hours=args.stale_hours)
     if args.chrome:
         from . import export_chrome_trace
         print(export_chrome_trace(args.chrome))
@@ -142,6 +327,14 @@ def main_selfcheck(argv=None):
     are passed through to :func:`main` unchanged."""
     argv = sys.argv[1:] if argv is None else argv
     return main(argv or ['--self-check'])
+
+
+def main_doctor(argv=None):
+    """Entry point for the ``nbodykit-tpu-doctor`` console script:
+    runs ``--doctor`` with any further arguments passed through
+    (``--self-check-only``, ``--trace DIR``, ``--root R``, ...)."""
+    argv = sys.argv[1:] if argv is None else argv
+    return main(['--doctor'] + list(argv))
 
 
 if __name__ == '__main__':

@@ -1,0 +1,486 @@
+"""Fleet-level trace analysis: merge per-process JSONL traces into one
+timeline with aligned clocks, attribute wall-clock to phases, and find
+stragglers and hung collectives.
+
+PR 1's flight recorder writes one ``trace-<pid>.jsonl`` per process;
+multi-host evidence therefore sits as disjoint files with unaligned
+wall clocks (different hosts, different NTP states).  The paper's
+premise is that every computation is a collective program, and that is
+exactly what makes the merge possible: a collective (barrier, exchange,
+distributed FFT) is *left together* by every participant, so matched
+collective spans are cross-process sync points.  The k-th occurrence
+of each anchor span name is matched across processes and the per-process
+clock offset is the median difference of the anchor *end* times —
+robust to a few asymmetric collectives and exact enough (~collective
+latency) for straggler attribution.
+
+What comes out (:func:`analyze`, rendered by :func:`render_analysis`):
+
+- a merged timeline of top-level spans over all processes,
+- a per-collective **straggler table** — which process entered each
+  anchor last, and by how much (the aligned *begin* skew; ends align
+  by construction, so begin skew is the wait the stragglers imposed),
+- a **critical-path breakdown** attributing end-to-end wall-clock to
+  paint / exchange / dfft / binning / compile phases (per process and
+  worst-across-processes, nested spans counted once),
+- **hung collectives** — a span closed on some processes but still
+  open on others (the classic wedged-all_to_all signature), plus
+  per-process heartbeat gaps (trace.py's ``hb`` records) so a SIGKILLed
+  worker is distinguishable from an idle one.
+
+Stdlib-only and tolerant of torn trace files (killed writers) — this
+module must run on a laptop against the wreckage of a dead TPU job.
+"""
+
+from .trace import read_trace, trace_files
+
+# span names treated as cross-process sync points (k-th occurrence of
+# each is matched across pids).  'barrier' is the explicit anchor the
+# multi-host workers emit; the rest are the collective hot paths.
+DEFAULT_ANCHORS = ('barrier', 'exchange', 'fft.r2c', 'fft.c2r',
+                   'fft.c2c', 'runtime.init_distributed')
+
+# span name -> critical-path phase
+_PHASE_PREFIXES = (
+    ('compile.', 'compile'),
+    ('fft.', 'dfft'),
+    ('exchange', 'exchange'),
+    ('paint', 'paint'),
+    ('readout', 'paint'),
+)
+
+
+def phase_of(name):
+    """The critical-path phase a span name belongs to, or None."""
+    # prefixes first: 'compile.fftpower.binning' is compile time, not
+    # binning time
+    for prefix, phase in _PHASE_PREFIXES:
+        if name.startswith(prefix):
+            return phase
+    if 'binning' in name:
+        return 'binning'
+    return None
+
+
+def _median(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    if not n:
+        return 0.0
+    if n % 2:
+        return vals[n // 2]
+    return 0.5 * (vals[n // 2 - 1] + vals[n // 2])
+
+
+def load_processes(path):
+    """Parse a trace file/directory into per-process record lists.
+
+    Returns ``(procs, torn)``: ``procs`` maps pid -> record list
+    (trace order preserved), ``torn`` counts unparseable lines summed
+    over files (killed writers).  Records missing a pid (foreign JSONL)
+    are dropped rather than fatal.
+    """
+    procs, torn = {}, 0
+    for f in trace_files(path):
+        records, bad = read_trace(f)
+        torn += bad
+        for r in records:
+            pid = r.get('pid')
+            if pid is None:
+                continue
+            procs.setdefault(int(pid), []).append(r)
+    return procs, torn
+
+
+def _anchor_spans(records, anchors):
+    """Per-name occurrence-indexed anchor spans: {(name, k): span}."""
+    seen = {}
+    out = {}
+    for r in records:
+        if r.get('t') != 'span':
+            continue
+        name = r.get('name', '')
+        if name not in anchors:
+            continue
+        k = seen.get(name, 0)
+        seen[name] = k + 1
+        out[(name, k)] = r
+    return out
+
+
+def clock_offsets(procs, anchors=DEFAULT_ANCHORS):
+    """Per-process clock offsets (seconds to ADD to that process's
+    timestamps), from matched anchor-span end times.
+
+    The reference process is the lowest pid (offset 0).  A process
+    sharing no anchors with the reference keeps offset 0 and is listed
+    in the returned ``unaligned`` set.
+    """
+    anchors = set(anchors)
+    pids = sorted(procs)
+    per_pid = {p: _anchor_spans(procs[p], anchors) for p in pids}
+    ref = pids[0]
+    offsets, unaligned, used = {ref: 0.0}, set(), 0
+    for p in pids[1:]:
+        common = set(per_pid[ref]) & set(per_pid[p])
+        if not common:
+            offsets[p] = 0.0
+            unaligned.add(p)
+            continue
+        deltas = []
+        for key in common:
+            a, b = per_pid[ref][key], per_pid[p][key]
+            end_ref = float(a.get('ts', 0)) + float(a.get('dur', 0))
+            end_p = float(b.get('ts', 0)) + float(b.get('dur', 0))
+            deltas.append(end_ref - end_p)
+        offsets[p] = _median(deltas)
+        used = max(used, len(common))
+    return offsets, unaligned, used
+
+
+def straggler_table(procs, offsets, anchors=DEFAULT_ANCHORS):
+    """Per-collective entry skew after clock alignment.
+
+    For each anchor occurrence present in >= 2 processes: who entered
+    last (the straggler — everyone else waited for them inside the
+    collective) and the begin-time spread.  Also aggregates per name:
+    occurrence count, worst/mean skew, and the most frequent straggler.
+    """
+    anchors = set(anchors)
+    per_pid = {p: _anchor_spans(procs[p], anchors) for p in procs}
+    keys = {}
+    for p, table in per_pid.items():
+        for key, r in table.items():
+            keys.setdefault(key, {})[p] = float(r.get('ts', 0)) \
+                + offsets.get(p, 0.0)
+    rows = []
+    for (name, k) in sorted(keys, key=lambda nk: (nk[0], nk[1])):
+        entries = keys[(name, k)]
+        if len(entries) < 2:
+            continue
+        last = max(entries, key=entries.get)
+        first = min(entries, key=entries.get)
+        rows.append({'name': name, 'occurrence': k,
+                     'straggler': last,
+                     'skew_s': round(entries[last] - entries[first], 6),
+                     'entries': {str(p): round(t, 6)
+                                 for p, t in sorted(entries.items())}})
+    by_name = {}
+    for row in rows:
+        st = by_name.setdefault(row['name'],
+                                {'count': 0, 'max_skew_s': 0.0,
+                                 'sum_skew_s': 0.0, 'stragglers': {}})
+        st['count'] += 1
+        st['max_skew_s'] = max(st['max_skew_s'], row['skew_s'])
+        st['sum_skew_s'] += row['skew_s']
+        key = str(row['straggler'])
+        st['stragglers'][key] = st['stragglers'].get(key, 0) + 1
+    for st in by_name.values():
+        st['mean_skew_s'] = round(st['sum_skew_s'] / st['count'], 6)
+        st['max_skew_s'] = round(st['max_skew_s'], 6)
+        st['sum_skew_s'] = round(st['sum_skew_s'], 6)
+        st['worst_straggler'] = max(st['stragglers'],
+                                    key=st['stragglers'].get)
+    return rows, by_name
+
+
+def _phase_totals(spans):
+    """Per-phase busy seconds with nested double counting removed: a
+    phased span's duration is charged to its own phase and subtracted
+    from its nearest phased ancestor (exchange time inside paint counts
+    as exchange, paint keeps the remainder)."""
+    by_id = {s.get('id'): s for s in spans}
+    contrib = {}
+    for s in spans:
+        if phase_of(s.get('name', '')) is not None:
+            contrib[s.get('id')] = float(s.get('dur', 0.0))
+    for s in spans:
+        sid = s.get('id')
+        if sid not in contrib:
+            continue
+        par = s.get('par', 0)
+        while par:
+            ps = by_id.get(par)
+            if ps is None:
+                break
+            if ps.get('id') in contrib:
+                contrib[ps.get('id')] -= float(s.get('dur', 0.0))
+                break
+            par = ps.get('par', 0)
+    totals = {}
+    for s in spans:
+        sid = s.get('id')
+        if sid in contrib:
+            p = phase_of(s.get('name', ''))
+            totals[p] = totals.get(p, 0.0) + max(contrib[sid], 0.0)
+    return {p: round(v, 6) for p, v in totals.items()}
+
+
+def critical_path(procs, offsets):
+    """End-to-end wall plus its phase attribution.
+
+    ``wall_s`` spans the aligned earliest begin to the latest end over
+    all processes.  The per-phase critical path is the MAX over
+    processes of that process's phase total — the collective program
+    runs at the pace of its slowest participant, so the worst process's
+    paint (etc.) is what end-to-end time actually paid.  ``other_s`` is
+    the unattributed remainder (host code, waits, unspanned work).
+    """
+    t0, t1 = None, None
+    per_process = {}
+    for p, records in procs.items():
+        off = offsets.get(p, 0.0)
+        spans = [r for r in records if r.get('t') == 'span']
+        for r in spans:
+            b = float(r.get('ts', 0.0)) + off
+            e = b + float(r.get('dur', 0.0))
+            t0 = b if t0 is None else min(t0, b)
+            t1 = e if t1 is None else max(t1, e)
+        for r in records:
+            if r.get('t') == 'b':
+                b = float(r.get('ts', 0.0)) + off
+                t0 = b if t0 is None else min(t0, b)
+                t1 = b if t1 is None else max(t1, b)
+        per_process[p] = _phase_totals(spans)
+    wall = round((t1 - t0), 6) if t0 is not None else 0.0
+    phases = {}
+    for totals in per_process.values():
+        for ph, v in totals.items():
+            phases[ph] = max(phases.get(ph, 0.0), v)
+    other = max(wall - sum(phases.values()), 0.0)
+    return {'wall_s': wall,
+            'phases': {p: round(v, 6)
+                       for p, v in sorted(phases.items())},
+            'other_s': round(other, 6),
+            'per_process': {str(p): per_process[p]
+                            for p in sorted(per_process)}}
+
+
+def find_hangs(procs):
+    """Cross-process open-span analysis.
+
+    ``in_flight``: per process, begin events with no close (what the
+    process was doing when the trace ends).  ``hung_collectives``: a
+    name CLOSED by at least one process but still OPEN on another — on
+    a collective that means the closed processes got out and the open
+    ones never did, i.e. the job wedged inside it (or the open process
+    died there).
+    """
+    open_by_pid, closed_names = {}, {}
+    for p, records in procs.items():
+        begins = {}
+        for r in records:
+            t = r.get('t')
+            if t == 'b':
+                begins[r.get('id')] = r
+            elif t == 'span':
+                begins.pop(r.get('id'), None)
+                closed_names.setdefault(r.get('name', '?'),
+                                        set()).add(p)
+        open_by_pid[p] = [{'name': b.get('name', '?'),
+                           'ts': b.get('ts'),
+                           'depth': b.get('depth', 0)}
+                          for b in begins.values()]
+    hung = []
+    for p, opens in open_by_pid.items():
+        for b in opens:
+            closed_on = closed_names.get(b['name'], set()) - {p}
+            if closed_on:
+                hung.append({'name': b['name'], 'open_pid': p,
+                             'ts': b['ts'],
+                             'closed_pids': sorted(closed_on)})
+    return {'in_flight': {str(p): opens
+                          for p, opens in sorted(open_by_pid.items())
+                          if opens},
+            'hung_collectives': sorted(
+                hung, key=lambda h: (h['name'], h['open_pid']))}
+
+
+def heartbeat_report(procs, offsets):
+    """Per-process liveness from the ``hb`` records: when was each
+    process last heard from (any record), and did it fall silent before
+    the trace ended (gap > 3 heartbeat intervals)?  Processes traced
+    without a heartbeat get ``silent: None`` (no liveness claim)."""
+    last_seen, hb = {}, {}
+    for p, records in procs.items():
+        off = offsets.get(p, 0.0)
+        last = None
+        iv, count = None, 0
+        for r in records:
+            ts = r.get('ts')
+            if ts is None:
+                continue
+            ts = float(ts) + off
+            last = ts if last is None else max(last, ts)
+            if r.get('t') == 'hb':
+                count += 1
+                iv = float(r.get('iv', 0)) or iv
+            elif r.get('t') == 'meta' and r.get('heartbeat_s'):
+                iv = float(r['heartbeat_s'])
+        last_seen[p] = last
+        hb[p] = (iv, count)
+    end = max((t for t in last_seen.values() if t is not None),
+              default=None)
+    out = {}
+    for p in sorted(procs):
+        iv, count = hb[p]
+        gap = None if (end is None or last_seen[p] is None) \
+            else round(end - last_seen[p], 6)
+        silent = None
+        if iv and gap is not None:
+            silent = gap > max(3.0 * iv, 2.0)
+        out[str(p)] = {'last_seen': last_seen[p], 'gap_s': gap,
+                       'hb_count': count, 'hb_interval_s': iv,
+                       'silent': silent}
+    return out
+
+
+def merge_timeline(procs, offsets, max_depth=0):
+    """All spans of depth <= ``max_depth`` over every process, clock
+    aligned and time ordered — the one timeline the per-process files
+    could not show.  Retroactive ``compile.*`` records are omitted:
+    they are emitted out-of-band at depth 0 and would drown the program
+    structure (they still feed the critical path's compile phase)."""
+    rows = []
+    for p, records in procs.items():
+        off = offsets.get(p, 0.0)
+        for r in records:
+            if r.get('t') != 'span' or r.get('depth', 0) > max_depth:
+                continue
+            if r.get('name', '').startswith('compile.'):
+                continue
+            rows.append({'ts': round(float(r.get('ts', 0)) + off, 6),
+                         'pid': p, 'name': r.get('name', '?'),
+                         'dur_s': round(float(r.get('dur', 0)), 6),
+                         'depth': r.get('depth', 0),
+                         'ok': r.get('ok', True)})
+    rows.sort(key=lambda r: (r['ts'], r['pid']))
+    return rows
+
+
+def analyze(path, anchors=None):
+    """Full fleet analysis of a trace file/directory; returns a plain
+    JSON-serializable dict (see module docstring for the pieces)."""
+    anchors = tuple(anchors) if anchors else DEFAULT_ANCHORS
+    procs, torn = load_processes(path)
+    nspans = sum(1 for rs in procs.values()
+                 for r in rs if r.get('t') == 'span')
+    if not procs:
+        return {'path': str(path), 'nprocs': 0, 'pids': [],
+                'nspans': 0, 'torn_lines': torn, 'empty': True}
+    offsets, unaligned, anchors_used = clock_offsets(procs, anchors)
+    rows, by_name = straggler_table(procs, offsets, anchors)
+    return {
+        'path': str(path),
+        'nprocs': len(procs),
+        'pids': sorted(procs),
+        'nspans': nspans,
+        'torn_lines': torn,
+        'clock_offsets': {str(p): round(o, 6)
+                          for p, o in sorted(offsets.items())},
+        'unaligned_pids': sorted(unaligned),
+        'anchors_used': anchors_used,
+        'timeline': merge_timeline(procs, offsets),
+        'stragglers': {'per_collective': rows, 'per_name': by_name},
+        'critical_path': critical_path(procs, offsets),
+        'hangs': find_hangs(procs),
+        'heartbeat': heartbeat_report(procs, offsets),
+    }
+
+
+def _fmt_ms(s):
+    return '%.3f ms' % (s * 1e3) if s < 1.0 else '%.3f s' % s
+
+
+def render_analysis(res, max_timeline=40):
+    """The analysis as an aligned plain-text report."""
+    out = []
+    w = out.append
+    w('== nbodykit_tpu fleet trace analysis ==')
+    if res.get('empty'):
+        w('no trace records under %s' % res.get('path'))
+        return '\n'.join(out) + '\n'
+    w('trace: %s   processes: %d (pids %s)   spans: %d'
+      % (res['path'], res['nprocs'],
+         ','.join(str(p) for p in res['pids']), res['nspans']))
+    if res.get('torn_lines'):
+        w('torn trace lines tolerated: %d (killed writer)'
+          % res['torn_lines'])
+    w('-- clock offsets (s, added to each pid; %d matched anchors) --'
+      % res.get('anchors_used', 0))
+    for p, off in res['clock_offsets'].items():
+        flag = '  [UNALIGNED: no shared anchors]' \
+            if int(p) in res.get('unaligned_pids', []) else ''
+        w('  pid %-8s %+12.6f%s' % (p, off, flag))
+
+    timeline = res.get('timeline', [])
+    if timeline:
+        w('-- merged timeline (top-level spans, aligned clocks) --')
+        t0 = timeline[0]['ts']
+        shown = timeline[:max_timeline]
+        for r in shown:
+            flag = '' if r.get('ok', True) else '  [FAILED]'
+            w('  +%10.4f s  pid %-8d %-32s %10.4f s%s'
+              % (r['ts'] - t0, r['pid'], r['name'], r['dur_s'], flag))
+        if len(timeline) > len(shown):
+            w('  ... %d more' % (len(timeline) - len(shown)))
+
+    per_name = res.get('stragglers', {}).get('per_name', {})
+    if per_name:
+        w('-- straggler report (per collective, begin skew after '
+          'alignment) --')
+        nw = max(len(n) for n in per_name)
+        w('  %-*s  %6s  %12s  %12s  %s'
+          % (nw, 'collective', 'count', 'max_skew', 'mean_skew',
+             'worst straggler'))
+        for name in sorted(per_name):
+            st = per_name[name]
+            w('  %-*s  %6d  %12s  %12s  pid %s (%d/%d)'
+              % (nw, name, st['count'], _fmt_ms(st['max_skew_s']),
+                 _fmt_ms(st['mean_skew_s']), st['worst_straggler'],
+                 st['stragglers'][st['worst_straggler']],
+                 st['count']))
+
+    cp = res.get('critical_path', {})
+    if cp:
+        w('-- critical path (worst process per phase; wall %.4f s) --'
+          % cp.get('wall_s', 0.0))
+        wall = cp.get('wall_s') or 1.0
+        for ph, v in sorted(cp.get('phases', {}).items(),
+                            key=lambda kv: -kv[1]):
+            w('  %-10s  %10.4f s  %5.1f%%' % (ph, v, 100.0 * v / wall))
+        w('  %-10s  %10.4f s  %5.1f%%'
+          % ('other', cp.get('other_s', 0.0),
+             100.0 * cp.get('other_s', 0.0) / wall))
+        if 'compile' in cp.get('phases', {}):
+            w('  (compile spans are recorded out-of-band and overlap '
+              'the phase they interrupted; phases may sum past 100%)')
+
+    hangs = res.get('hangs', {})
+    if hangs.get('hung_collectives'):
+        w('-- HUNG COLLECTIVES (open on some processes, closed on '
+          'others) --')
+        for h in hangs['hung_collectives']:
+            w('  %-32s  open on pid %d, closed on pids %s'
+              % (h['name'], h['open_pid'],
+                 ','.join(str(p) for p in h['closed_pids'])))
+    elif hangs.get('in_flight'):
+        w('-- in flight at end of trace --')
+        for p, opens in hangs['in_flight'].items():
+            for b in opens:
+                w('  pid %-8s %s%s' % (p, '  ' * b.get('depth', 0),
+                                       b['name']))
+
+    hb = res.get('heartbeat', {})
+    silent = [p for p, st in hb.items() if st.get('silent')]
+    if silent:
+        w('-- SILENT PROCESSES (heartbeat stopped before trace end) --')
+        for p in silent:
+            st = hb[p]
+            w('  pid %-8s last heard %.1f s before the trace end '
+              '(heartbeat every %.1f s) — killed or wedged'
+              % (p, st['gap_s'], st['hb_interval_s']))
+    elif any(st.get('hb_count') for st in hb.values()):
+        w('heartbeats: all %d processes alive to the end of the trace'
+          % len(hb))
+    return '\n'.join(out) + '\n'

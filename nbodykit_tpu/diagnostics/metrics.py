@@ -15,9 +15,23 @@ Instrumentation that runs *inside* a jitted function executes once per
 trace (compilation), not once per device execution — counters bumped
 there (e.g. ops/paint.py's kernel-trace counters) are labeled
 ``*.trace.*`` to make that explicit.
+
+Compile telemetry ("why was rep 1 slow") lives here too:
+
+- :func:`install_compile_telemetry` hooks ``jax.monitoring`` so every
+  XLA compile lands as ``xla.compile.*`` histograms plus persistent
+  compilation-cache hit/miss counters (``xla.cache.*``), and — when a
+  tracer is active — a retroactive ``compile.backend`` span in the
+  trace file.
+- :func:`instrumented_jit` is a drop-in ``jax.jit`` that attributes
+  compiles to a *named* entry point: per-label hit/miss counters, a
+  first-call-wall histogram, and a ``compile.<label>`` span on every
+  cache miss.  The jit hot paths (pmesh.py, parallel/dfft.py,
+  ops/paint.py, algorithms/fftpower.py, bench.py) route through it.
 """
 
 import threading
+import time
 
 
 class Counter(object):
@@ -154,6 +168,129 @@ REGISTRY = MetricsRegistry()
 counter = REGISTRY.counter
 gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram
+
+
+# ---------------------------------------------------------------------------
+# compile telemetry
+
+# jax.monitoring event name -> registry counter
+_XLA_EVENT_COUNTERS = {
+    '/jax/compilation_cache/cache_hits': 'xla.cache.hits',
+    '/jax/compilation_cache/cache_misses': 'xla.cache.misses',
+    '/jax/compilation_cache/compile_requests_use_cache':
+        'xla.cache.requests',
+}
+# jax.monitoring duration event -> registry histogram
+_XLA_DURATION_EVENTS = {
+    '/jax/core/compile/jaxpr_trace_duration': 'xla.compile.trace_s',
+    '/jax/core/compile/jaxpr_to_mlir_module_duration':
+        'xla.compile.lower_s',
+    '/jax/core/compile/backend_compile_duration':
+        'xla.compile.backend_s',
+}
+_monitoring_lock = threading.Lock()
+_monitoring_installed = False
+
+
+def install_compile_telemetry():
+    """Route jax.monitoring compile/cache events into the registry.
+
+    Idempotent and cheap; called at import by the jit hot paths (they
+    all import jax anyway) so XLA recompiles are never invisible.  Each
+    backend compile also lands as a retroactive ``compile.backend``
+    span when a tracer is active — the out-of-band path, since jax
+    reports the duration only after the fact.  Returns True when the
+    hook is (already) installed, False when jax.monitoring is missing.
+    """
+    global _monitoring_installed
+    with _monitoring_lock:
+        if _monitoring_installed:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:
+            return False
+
+        def _on_event(event, **kw):
+            name = _XLA_EVENT_COUNTERS.get(event)
+            if name is not None:
+                REGISTRY.counter(name).add(1)
+
+        def _on_duration(event, duration, **kw):
+            name = _XLA_DURATION_EVENTS.get(event)
+            if name is None:
+                return
+            REGISTRY.histogram(name).observe(duration)
+            if event.endswith('backend_compile_duration'):
+                from .trace import current_tracer
+                tr = current_tracer()
+                if tr is not None:
+                    tr.emit_span('compile.backend',
+                                 time.time() - duration, duration)
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _monitoring_installed = True
+        return True
+
+
+def instrumented_jit(fun=None, label=None, **jit_kwargs):
+    """``jax.jit`` plus per-entry-point compile telemetry.
+
+    Every eager call checks the jit cache size before/after dispatch:
+    a growth is a compile attributed to ``label`` —
+    ``compile.<label>.misses`` is bumped, the first-call wall (compile
+    + one execution) lands in ``compile.<label>.first_call_s``, and a
+    ``compile.<label>`` span is written to the active trace; a re-used
+    executable bumps ``compile.<label>.hits``.  Calls made while jax is
+    staging an outer trace pass straight through (the inner jit is
+    inlined there; host-side bookkeeping would be noise).
+
+    Usable exactly like ``jax.jit`` (decorator or call form); extra
+    keyword arguments (``donate_argnums``, ...) are forwarded.
+    """
+    if fun is None:
+        return lambda f: instrumented_jit(f, label=label, **jit_kwargs)
+    import functools
+    import jax
+    install_compile_telemetry()
+    jitted = jax.jit(fun, **jit_kwargs)
+    lbl = label or getattr(fun, '__name__', None) or 'fn'
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        from .trace import current_tracer, trace_state_clean
+        if not trace_state_clean():
+            return jitted(*args, **kwargs)
+        try:
+            n0 = jitted._cache_size()
+        except Exception:       # pragma: no cover - jax internals moved
+            return jitted(*args, **kwargs)
+        ts = time.time()
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        try:
+            n1 = jitted._cache_size()
+        except Exception:       # pragma: no cover
+            return out
+        if n1 > n0:
+            dt = time.perf_counter() - t0
+            REGISTRY.counter('compile.%s.misses' % lbl).add(n1 - n0)
+            REGISTRY.histogram(
+                'compile.%s.first_call_s' % lbl).observe(dt)
+            tr = current_tracer()
+            if tr is not None:
+                # first-call wall, compile included (the execution share
+                # is usually noise next to it; xla.compile.* histograms
+                # hold the pure-compile stages)
+                tr.emit_span('compile.%s' % lbl, ts, dt,
+                             {'misses': n1 - n0})
+        else:
+            REGISTRY.counter('compile.%s.hits' % lbl).add(1)
+        return out
+
+    wrapper._jitted = jitted    # escape hatch: .lower(), cache control
+    return wrapper
 
 
 def device_watermarks(registry=None):

@@ -1,0 +1,317 @@
+"""Bench regression tracking: the BENCH_r*.json trajectory as data.
+
+Every round commits one ``BENCH_rNN.json`` (the driver's record of
+``python bench.py``: rc, output tail, the parsed headline JSON line),
+plus the committed measurement stores ``BASELINE_CPU.json`` /
+``BENCH_TPU_CACHE.json``.  Until now that history was interpreted by
+hand — and round 5 silently headlined a 4-day-old cache replay as if
+it were a fresh TPU measurement.  This module makes the trajectory
+machine-checked:
+
+- :func:`load_rounds` ingests the family and normalizes each round to
+  one entry (metric, value, platform, note, replay provenance);
+- :func:`classify` assigns each entry a verdict —
+
+  ``malformed``    unreadable JSON, or a "successful" round whose
+                   record is missing metric/value/unit (gate-failing:
+                   scripts/smoke.sh runs ``--regress`` so a broken
+                   bench record cannot land),
+  ``no-result``    the round produced no number and said so (rc != 0);
+  ``stale``        the record is a cache replay whose underlying
+                   measurement is older than ``stale_hours`` — the
+                   round-5 failure mode, now loud,
+  ``replay``       a cache replay of unknown age,
+  ``regression``   value worse than the previous round's same-metric
+                   value by more than ``threshold`` (relative),
+  ``improved`` / ``ok`` otherwise;
+
+- :func:`build_history` writes the whole thing to ``BENCH_HISTORY.json``
+  atomically (same tmp+rename discipline as report.py) so the next
+  round — and the doctor — reads one file, not eight.
+
+Stale evidence is judged against *now* by default: the question the
+doctor answers is "is this number fresh enough to act on today", not
+"was it fresh when committed".  Pass ``now`` for reproducible tests.
+"""
+
+import calendar
+import glob
+import json
+import os
+import re
+import time
+
+from .trace import atomic_write
+
+HISTORY_NAME = 'BENCH_HISTORY.json'
+ROUND_GLOBS = ('BENCH_r*.json', 'MULTICHIP_r*.json')
+CACHE_FILES = ('BENCH_TPU_CACHE.json', 'BASELINE_CPU.json')
+# note text that marks a headline as replayed from the TPU cache
+# rather than measured live this round (bench.py main())
+_REPLAY_MARKERS = ('BENCH_TPU_CACHE', 'most recent real-TPU')
+_TS_RE = re.compile(r'(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2})Z?')
+
+
+def parse_utc(ts):
+    """Epoch seconds for a ``YYYY-MM-DDTHH:MM:SSZ`` stamp, or None."""
+    if not ts:
+        return None
+    m = _TS_RE.search(str(ts))
+    if not m:
+        return None
+    try:
+        return calendar.timegm(
+            time.strptime(m.group(1), '%Y-%m-%dT%H:%M:%S'))
+    except ValueError:
+        return None
+
+
+def _round_key(path):
+    m = re.search(r'_r(\d+)\.json$', path)
+    return (os.path.basename(path).split('_r')[0],
+            int(m.group(1)) if m else 0)
+
+
+def load_rounds(root):
+    """Normalize every committed round file under ``root`` into one
+    entry per file, oldest round first per family."""
+    entries = []
+    for pattern in ROUND_GLOBS:
+        for path in sorted(glob.glob(os.path.join(root, pattern)),
+                           key=_round_key):
+            fname = os.path.basename(path)
+            entry = {'file': fname, 'round': _round_key(path)[1],
+                     'family': fname.split('_r')[0]}
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (OSError, ValueError) as e:
+                entry.update(load_error='unreadable: %s' % e)
+                entries.append(entry)
+                continue
+            entry['rc'] = data.get('rc')
+            # some round families (MULTICHIP_r*) record a pass/fail
+            # probe, not a parsed headline metric — legitimate, not
+            # malformed
+            entry['has_headline'] = 'parsed' in data
+            for k in ('ok', 'skipped'):
+                if k in data:
+                    entry[k] = data[k]
+            rec = data.get('parsed')
+            if isinstance(rec, dict):
+                for k in ('metric', 'value', 'unit', 'platform',
+                          'vs_baseline', 'note', 'measured_at',
+                          'cache_age_hours'):
+                    if rec.get(k) is not None:
+                        entry[k] = rec[k]
+                if rec.get('error') is not None:
+                    entry['record_error'] = rec['error']
+            entries.append(entry)
+    return entries
+
+
+def _is_replay(entry):
+    if entry.get('cache_age_hours') is not None:
+        return True
+    note = str(entry.get('note', ''))
+    return any(m in note for m in _REPLAY_MARKERS)
+
+
+def _age_hours(entry, now):
+    """Age of the underlying measurement, preferring the explicit
+    ``cache_age_hours`` stamp (bench.py), else the ``measured_at`` /
+    'taken at ...Z' timestamp embedded in the record or its note."""
+    age = entry.get('cache_age_hours')
+    if age is not None:
+        try:
+            return float(age)
+        except (TypeError, ValueError):
+            pass
+    ts = parse_utc(entry.get('measured_at')) \
+        or parse_utc(entry.get('note'))
+    if ts is None:
+        return None
+    return (now - ts) / 3600.0
+
+
+def classify(entries, threshold=0.25, stale_hours=24.0, now=None):
+    """Assign each entry a ``verdict`` (+ ``why``) in place and return
+    the entries.  Regressions compare consecutive rounds of the SAME
+    metric (a 256-cubed timing vs a 1024-cubed one is not a trend)."""
+    now = time.time() if now is None else now
+    last_by_metric = {}
+    for entry in entries:
+        if entry.get('load_error'):
+            entry['verdict'] = 'malformed'
+            entry['why'] = entry['load_error']
+            continue
+        if not entry.get('has_headline'):
+            entry['verdict'] = 'no-result'
+            entry['why'] = ('round family records no headline metric '
+                            '(ok=%s, skipped=%s)'
+                            % (entry.get('ok'), entry.get('skipped')))
+            continue
+        value = entry.get('value')
+        ok_shape = (entry.get('metric') and entry.get('unit')
+                    and isinstance(value, (int, float)))
+        if not ok_shape or (isinstance(value, (int, float))
+                            and value <= 0):
+            if entry.get('rc') not in (0, None) or \
+                    (isinstance(value, (int, float)) and value <= 0):
+                entry['verdict'] = 'no-result'
+                entry['why'] = ('round recorded a failure (rc=%s)%s'
+                                % (entry.get('rc'),
+                                   ': %s' % entry['record_error']
+                                   if entry.get('record_error') else ''))
+            else:
+                entry['verdict'] = 'malformed'
+                entry['why'] = ('rc=0 but the record is missing '
+                                'metric/value/unit')
+            continue
+        replay = _is_replay(entry)
+        age = _age_hours(entry, now)
+        entry['replay'] = replay
+        if age is not None:
+            entry['age_hours'] = round(age, 1)
+        prev = last_by_metric.get(entry['metric'])
+        verdict, why = 'ok', ''
+        if prev is not None and prev > 0:
+            rel = (value - prev) / prev
+            if rel > threshold:
+                verdict = 'regression'
+                why = ('%.4g s vs %.4g s previous (+%.0f%%, '
+                       'threshold %.0f%%)'
+                       % (value, prev, 100 * rel, 100 * threshold))
+            elif rel < -threshold:
+                verdict = 'improved'
+                why = '%.4g s vs %.4g s previous (%.0f%%)' \
+                    % (value, prev, 100 * rel)
+        if replay:
+            if age is not None and age > stale_hours:
+                verdict = 'stale'
+                why = ('cache replay of a measurement %.0f h old '
+                       '(stale after %.0f h) — NOT a fresh number'
+                       % (age, stale_hours))
+            elif verdict in ('ok', 'improved'):
+                verdict = 'replay'
+                why = 'cache replay, not a live measurement'
+        entry['verdict'] = verdict
+        if why:
+            entry['why'] = why
+        # replays do not advance the comparison baseline: the next live
+        # measurement should be judged against the last LIVE one
+        if not replay:
+            last_by_metric[entry['metric']] = value
+    return entries
+
+
+def load_caches(root, stale_hours=24.0, now=None):
+    """Summarize the committed measurement stores: per metric, value +
+    measurement age, staleness-flagged."""
+    now = time.time() if now is None else now
+    out = {}
+    for fname in CACHE_FILES:
+        path = os.path.join(root, fname)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except OSError:
+            continue
+        except ValueError as e:
+            out[fname] = {'error': 'unreadable: %s' % e}
+            continue
+        summary = {}
+        for metric, rec in sorted(data.get('results', {}).items()):
+            ts = parse_utc(rec.get('measured_at'))
+            age = None if ts is None else round((now - ts) / 3600.0, 1)
+            summary[metric] = {
+                'value': rec.get('value'),
+                'platform': rec.get('platform'),
+                'measured_at': rec.get('measured_at'),
+                'age_hours': age,
+                'stale': None if age is None else age > stale_hours,
+            }
+        out[fname] = summary
+    return out
+
+
+def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
+                  now=None, write=True):
+    """Assemble + (atomically) write ``BENCH_HISTORY.json``; returns
+    the history dict.  ``write=False`` analyzes without touching disk.
+    """
+    entries = classify(load_rounds(root), threshold=threshold,
+                       stale_hours=stale_hours, now=now)
+    history = {
+        'generated_at': time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                      time.gmtime(now)),
+        'root': os.path.abspath(root),
+        'threshold': threshold,
+        'stale_hours': stale_hours,
+        'rounds': entries,
+        'caches': load_caches(root, stale_hours=stale_hours, now=now),
+        'summary': {v: sum(1 for e in entries
+                           if e.get('verdict') == v)
+                    for v in ('ok', 'improved', 'replay', 'stale',
+                              'regression', 'no-result', 'malformed')},
+    }
+    if write:
+        path = out or os.path.join(root, HISTORY_NAME)
+        atomic_write(path, json.dumps(history, indent=1, default=str))
+        history['path'] = path
+    return history
+
+
+def render_regress(history):
+    """The history as an aligned plain-text report."""
+    out = []
+    w = out.append
+    w('== nbodykit_tpu bench regression report ==')
+    w('root: %s   rounds: %d   threshold: %.0f%%   stale after: %.0f h'
+      % (history['root'], len(history['rounds']),
+         100 * history['threshold'], history['stale_hours']))
+    rounds = history['rounds']
+    if rounds:
+        fw = max(len(e['file']) for e in rounds)
+        for e in rounds:
+            v = e.get('value')
+            val = '%10.4f s' % v if isinstance(v, (int, float)) \
+                else '         --'
+            line = '  %-*s  %-44s %s  %-10s' \
+                % (fw, e['file'], e.get('metric', '(no record)')[:44],
+                   val, e.get('verdict', '?').upper())
+            if e.get('why'):
+                line += '  %s' % e['why']
+            w(line)
+    caches = history.get('caches', {})
+    for fname, summary in sorted(caches.items()):
+        if 'error' in summary:
+            w('  %s: MALFORMED (%s)' % (fname, summary['error']))
+            continue
+        stale = [m for m, st in summary.items() if st.get('stale')]
+        w('  %s: %d metrics%s'
+          % (fname, len(summary),
+             ', %d older than the stale bar (fine for a cache; loud '
+             'only when replayed as a headline)' % len(stale)
+             if stale else ''))
+    s = history['summary']
+    w('verdicts: %s' % '  '.join('%s=%d' % (k, n)
+                                 for k, n in s.items() if n))
+    bad = s.get('malformed', 0)
+    warn = s.get('stale', 0) + s.get('regression', 0)
+    if bad:
+        w('RESULT: FAIL — %d malformed bench record(s)' % bad)
+    elif warn:
+        w('RESULT: WARN — %d stale replay / regression verdict(s); '
+          'treat the affected numbers as evidence to refresh, not '
+          'results' % warn)
+    else:
+        w('RESULT: OK')
+    return '\n'.join(out) + '\n'
+
+
+def gate_rc(history):
+    """Exit code for CI gates: malformed records fail; stale replays
+    and regressions warn loudly but do not block (the committed round-5
+    replay must not wedge every future smoke run)."""
+    return 1 if history['summary'].get('malformed') else 0

@@ -27,6 +27,14 @@ Spans nest per-thread; exceptions are recorded (``ok: false`` plus the
 exception repr) and re-raised.  Durations use the monotonic
 ``time.perf_counter``; the wall-clock ``ts`` is kept for aligning
 traces across processes.
+
+A background **heartbeat** thread additionally appends a tiny ``hb``
+record every ``NBKIT_DIAGNOSTICS_HEARTBEAT`` seconds (default 5; 0
+disables).  Spans only prove a process was alive when it *finished*
+something — a worker wedged inside one long collective writes nothing.
+The heartbeat gives the fleet analyzer (analyze.py) a per-process
+liveness signal, so a SIGKILLed or hung worker is distinguishable
+post-mortem from one that merely had no spans to emit.
 """
 
 import atexit
@@ -201,9 +209,20 @@ class Tracer(object):
         self._wlock = threading.Lock()
         self._tls = threading.local()
         self._next_id = 0
+        try:
+            self.heartbeat_s = float(os.environ.get(
+                'NBKIT_DIAGNOSTICS_HEARTBEAT', '5') or 0)
+        except ValueError:
+            self.heartbeat_s = 5.0
         self._emit({'t': 'meta', 'version': 1, 'pid': self.pid,
                     'ts': round(time.time(), 6),
-                    'argv': [str(a) for a in getattr(sys, 'argv', [])]})
+                    'argv': [str(a) for a in getattr(sys, 'argv', [])],
+                    'heartbeat_s': self.heartbeat_s})
+        self._hb_stop = threading.Event()
+        if self.heartbeat_s > 0:
+            t = threading.Thread(target=self._hb_loop, daemon=True,
+                                 name='nbkit-trace-heartbeat')
+            t.start()
         atexit.register(self._at_exit)
 
     # -- internals --------------------------------------------------------
@@ -234,6 +253,17 @@ class Tracer(object):
                 except OSError:     # pragma: no cover - exotic fs
                     pass
 
+    def _hb_loop(self):
+        # flush, no fsync: an OS-level write survives a SIGKILL of this
+        # process, and the heartbeat must stay near-free.  The wait
+        # doubles as the stop signal so close() never blocks on us.
+        while not self._hb_stop.wait(self.heartbeat_s):
+            if self._f.closed:
+                return
+            self._emit({'t': 'hb', 'pid': self.pid,
+                        'ts': round(time.time(), 6),
+                        'iv': self.heartbeat_s}, sync=False)
+
     def _at_exit(self):
         # end-of-run summary on clean interpreter exit (a crash relies
         # on the per-span fsyncs instead); atomic, never raises.  A
@@ -252,7 +282,22 @@ class Tracer(object):
     def span(self, name, attrs=None):
         return _Span(self, name, attrs)
 
+    def emit_span(self, name, ts, dur, attrs=None, ok=True):
+        """Record a completed span observed out-of-band — e.g. a compile
+        reported after the fact by ``jax.monitoring`` (metrics.py), where
+        there was no way to enter a context manager before the work ran.
+        ``ts`` is the wall-clock start, ``dur`` the duration in seconds;
+        the record is a normal top-level span to every reader."""
+        rec = {'t': 'span', 'id': self._new_id(), 'par': 0,
+               'name': name, 'ts': round(float(ts), 6),
+               'dur': round(float(dur), 6), 'depth': 0,
+               'pid': self.pid, 'ok': bool(ok)}
+        if attrs:
+            rec['attrs'] = dict(attrs)
+        self._emit(rec)
+
     def close(self):
+        self._hb_stop.set()
         with self._wlock:
             if not self._f.closed:
                 try:

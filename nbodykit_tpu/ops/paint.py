@@ -28,7 +28,12 @@ from .window import window_weights, window_support
 # enclosing program (these kernels run inside jit/shard_map), not per
 # execution — they document which kernel got traced at what size, not
 # how often it ran (see diagnostics/metrics.py)
-from ..diagnostics import counter, gauge
+from ..diagnostics import counter, gauge, install_compile_telemetry
+
+# the paint kernels compile inside their enclosing jit: the *.trace.*
+# counters below count traces, the xla.compile.* histograms this hook
+# feeds time the actual backend compiles
+install_compile_telemetry()
 
 # default cap on the mxu paint's per-piece one-hot Z expansion; shared
 # with pmesh.memory_plan so the estimate tracks the kernel

@@ -38,8 +38,13 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .runtime import AXIS, mesh_size
-from ..diagnostics import counter, current_tracer, histogram, span, \
-    span_if
+from ..diagnostics import counter, current_tracer, histogram, \
+    install_compile_telemetry, instrumented_jit, span, span_if
+
+# every XLA compile triggered by the FFT paths lands in the metric
+# registry (xla.compile.* / xla.cache.*) — answers "why was rep 1
+# slow" from the trace alone
+install_compile_telemetry()
 
 
 def _fft_chunk_bytes():
@@ -169,14 +174,14 @@ def _lowmem_inv_programs(shape, dtype_str, Nmesh2, norm, target):
         z = jnp.zeros((), i.dtype)
         return jax.lax.dynamic_update_slice(dst, s, (i, z, z))
 
-    @jax.jit
+    @instrumented_jit(label='fft.lowmem.c2r.slab_a')
     def slab_a(y, j):
         z = jnp.zeros((), j.dtype)
         yc = jax.lax.dynamic_slice(y, (j, z, z), (r1, N0, Nc))
         return jnp.transpose(jnp.fft.ifft(yc, axis=1, norm=norm),
                              (1, 0, 2))
 
-    @jax.jit
+    @instrumented_jit(label='fft.lowmem.c2r.slab_b')
     def slab_b(zf, i):
         z = jnp.zeros((), i.dtype)
         sl = jax.lax.dynamic_slice(zf, (i, z, z), (r0, N1, Nc))
@@ -186,8 +191,10 @@ def _lowmem_inv_programs(shape, dtype_str, Nmesh2, norm, target):
     zeros_z = jax.jit(lambda: jnp.zeros((N0, N1, Nc), cdt))
     zeros_out = jax.jit(lambda: jnp.zeros((N0, N1, Nmesh2), rdt))
     return (r1, r0, zeros_z, zeros_out, slab_a,
-            jax.jit(_upd_a, donate_argnums=(0,)), slab_b,
-            jax.jit(_upd_b, donate_argnums=(0,)))
+            instrumented_jit(_upd_a, label='fft.lowmem.c2r.upd',
+                             donate_argnums=(0,)), slab_b,
+            instrumented_jit(_upd_b, label='fft.lowmem.c2r.upd',
+                             donate_argnums=(0,)))
 
 
 @_lru_cache(maxsize=16)
@@ -213,14 +220,14 @@ def _lowmem_programs(shape, dtype_str, norm, target):
         z = jnp.zeros((), i.dtype)
         return jax.lax.dynamic_update_slice(dst, s, (i, z, z))
 
-    @jax.jit
+    @instrumented_jit(label='fft.lowmem.r2c.slab_a')
     def slab_a(x, i):
         z = jnp.zeros((), i.dtype)
         xc = jax.lax.dynamic_slice(x, (i, z, z), (r0, N1, N2))
         return jnp.fft.fft(jnp.fft.rfft(xc, axis=2, norm=norm),
                            axis=1, norm=norm).astype(cdt)
 
-    @jax.jit
+    @instrumented_jit(label='fft.lowmem.r2c.slab_b')
     def slab_b(y, j):
         z = jnp.zeros((), j.dtype)
         yc = jax.lax.dynamic_slice(y, (z, j, z), (N0, r1, Nc))
@@ -230,8 +237,10 @@ def _lowmem_programs(shape, dtype_str, norm, target):
     zeros_y = jax.jit(lambda: jnp.zeros((N0, N1, Nc), cdt))
     zeros_out = jax.jit(lambda: jnp.zeros((N1, N0, Nc), cdt))
     return (r0, r1, zeros_y, zeros_out, slab_a,
-            jax.jit(_upd, donate_argnums=(0,)), slab_b,
-            jax.jit(_upd, donate_argnums=(0,)))
+            instrumented_jit(_upd, label='fft.lowmem.r2c.upd',
+                             donate_argnums=(0,)), slab_b,
+            instrumented_jit(_upd, label='fft.lowmem.r2c.upd',
+                             donate_argnums=(0,)))
 
 
 def _rfftn_single_chunked(x, norm, target):

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import _global_options
-from .diagnostics import current_tracer, histogram, span, \
+from .diagnostics import current_tracer, histogram, \
+    install_compile_telemetry, span, \
     trace_state_clean
 from .parallel.runtime import AXIS, CurrentMesh, mesh_size, shard_leading
 from .parallel import dfft
@@ -40,6 +41,10 @@ from .parallel.exchange import exchange_by_dest
 from .ops.window import window_support
 from .ops.paint import (paint_local, paint_local_sorted, paint_local_mxu,
                         readout_local)
+
+# compile telemetry for the paint/FFT entry points below: XLA compiles
+# and compilation-cache hits/misses land in the metric registry
+install_compile_telemetry()
 
 
 def _triplet(x, dtype):

@@ -15,9 +15,20 @@ export JAX_PLATFORMS=cpu
 echo "== diagnostics self-check =="
 python -m nbodykit_tpu.diagnostics --self-check
 
+# the doctor's self-check verdict block (the module form works without
+# installing the nbodykit-tpu-doctor console script)
+echo "== doctor: self-check =="
+python -m nbodykit_tpu.diagnostics --doctor --self-check-only
+
+# bench-record gate: a malformed committed BENCH_r*.json fails here;
+# stale cache replays / regressions print WARN verdicts but pass
+echo "== doctor: bench regression gate =="
+python -m nbodykit_tpu.diagnostics --regress .
+
 echo "== tier-1 fast subset =="
 python -m pytest \
     tests/test_diagnostics.py \
+    tests/test_diagnostics_analyze.py \
     tests/test_pmesh.py \
     tests/test_fftpower.py \
     tests/test_counted_exchange.py \

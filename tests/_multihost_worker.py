@@ -9,10 +9,22 @@ scalars every process must agree on.
 """
 
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+# this worker's contract is 4 CPU devices per process; a forced device
+# count inherited from the parent (conftest.py's set_cpu_devices(8)
+# fallback exports XLA_FLAGS) would silently double the world size and
+# break the slab-height checks — scrub it before jax initializes
+_flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '',
+                os.environ.get('XLA_FLAGS', '')).strip()
+if _flags:
+    os.environ['XLA_FLAGS'] = _flags
+else:
+    os.environ.pop('XLA_FLAGS', None)
 
 import jax
 
@@ -29,13 +41,31 @@ import jax.numpy as jnp  # noqa: E402
 # but a truncated stdout).  Per-process files (trace-<pid>.jsonl) under
 # one directory; NBKIT_DIAGNOSTICS overrides the location, an empty
 # value disables.  Read back with
-# ``python -m nbodykit_tpu.diagnostics --report <dir>``.
+# ``python -m nbodykit_tpu.diagnostics --report <dir>`` (one process)
+# or ``--analyze <dir>`` (merged timeline, stragglers, hangs).
 from nbodykit_tpu import diagnostics  # noqa: E402
 
-_TRACE_DIR = os.environ.get('NBKIT_DIAGNOSTICS',
-                            '/tmp/nbodykit-tpu-multihost-trace')
-if _TRACE_DIR:
-    diagnostics.configure(_TRACE_DIR)
+diagnostics.configure_from_env(default='/tmp/nbodykit-tpu-multihost-trace')
+
+
+def _barrier(mesh, tag):
+    """An explicit cross-process sync point wrapped in a ``barrier``
+    span: a replicated-scalar psum over the whole mesh is a collective
+    every process leaves together, so the analyzer
+    (diagnostics/analyze.py) gets a guaranteed clock-alignment anchor
+    per worker regardless of what the pipeline under test emits."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from nbodykit_tpu.parallel.runtime import AXIS
+    ndev = len(jax.devices())
+    x = jax.make_array_from_callback(
+        (ndev,), NamedSharding(mesh, P(AXIS)),
+        lambda idx: np.ones(ndev, 'f4')[idx])
+    allsum = jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(jnp.sum(v), AXIS), mesh=mesh,
+        in_specs=P(AXIS), out_specs=P()))
+    with diagnostics.span('barrier', point=tag):
+        total = float(allsum(x))
+    assert total == ndev, (tag, total, ndev)
 
 
 def main():
@@ -44,6 +74,16 @@ def main():
     from nbodykit_tpu.parallel.runtime import init_distributed, \
         world_mesh
     if nprocs > 1:
+        try:
+            # cross-process collectives on the CPU backend need the
+            # gloo transport (else every multi-process computation
+            # fails with "Multiprocess computations aren't implemented
+            # on the CPU backend"); it requires the distributed client,
+            # so only the multi-process path sets it
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:       # older jaxlib without the option
+            pass
         assert init_distributed(coordinator_address=coord,
                                 num_processes=nprocs, process_id=pid)
     if mode == 'batch':
@@ -52,6 +92,7 @@ def main():
                           proc=pid):
         mesh = world_mesh()
         ndev = len(jax.devices())
+        _barrier(mesh, 'start')
 
         from nbodykit_tpu.pmesh import ParticleMesh
         pm = ParticleMesh(Nmesh=16, BoxSize=50.0, dtype='f4', comm=mesh)
@@ -73,6 +114,7 @@ def main():
         total = float(jnp.sum(field.astype(jnp.float32)))
         c = pm.r2c(field)
         p2 = float(jnp.sum(jnp.abs(c) ** 2))
+        _barrier(mesh, 'end')
     print("RESULT %d %.6e %.6e" % (ndev, total, p2), flush=True)
 
 

@@ -160,6 +160,53 @@ os.kill(os.getpid(), 9)   # SIGKILL: no exit handlers run
     assert 'inflight' in {b['name'] for b in begins}
 
 
+def test_heartbeat_records_written(tmp_path, monkeypatch):
+    """The background heartbeat leaves periodic hb records so a wedged
+    or SIGKILLed worker is distinguishable post-mortem (analyze.py
+    flags the gap)."""
+    import time
+    monkeypatch.setenv('NBKIT_DIAGNOSTICS_HEARTBEAT', '0.05')
+    tr = diagnostics.configure(str(tmp_path))
+    assert tr.heartbeat_s == 0.05
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        records, _ = read_trace(str(tmp_path))
+        if sum(1 for r in records if r.get('t') == 'hb') >= 2:
+            break
+        time.sleep(0.05)
+    diagnostics.configure(None)
+    records, _ = read_trace(str(tmp_path))
+    hbs = [r for r in records if r.get('t') == 'hb']
+    assert len(hbs) >= 2
+    assert all(r['pid'] == os.getpid() and r['iv'] == 0.05
+               for r in hbs)
+    meta = next(r for r in records if r.get('t') == 'meta')
+    assert meta['heartbeat_s'] == 0.05
+
+
+def test_heartbeat_disabled(tmp_path, monkeypatch):
+    import time
+    monkeypatch.setenv('NBKIT_DIAGNOSTICS_HEARTBEAT', '0')
+    diagnostics.configure(str(tmp_path))
+    with span('s'):
+        time.sleep(0.05)
+    diagnostics.configure(None)
+    records, _ = read_trace(str(tmp_path))
+    assert not any(r.get('t') == 'hb' for r in records)
+
+
+def test_emit_span_retroactive(tmp_path):
+    """Out-of-band completed spans (compile telemetry) are normal
+    records to every reader."""
+    tr = diagnostics.configure(str(tmp_path))
+    tr.emit_span('compile.backend', 123.0, 0.25, {'src': 'test'})
+    diagnostics.configure(None)
+    spans, _ = _spans(str(tmp_path))
+    rec = next(s for s in spans if s['name'] == 'compile.backend')
+    assert rec['ts'] == 123.0 and rec['dur'] == 0.25
+    assert rec['depth'] == 0 and rec['attrs'] == {'src': 'test'}
+
+
 # ---------------------------------------------------------------------------
 # metrics
 
@@ -189,6 +236,45 @@ def test_metric_registry_reset_between_tests_a():
 def test_metric_registry_reset_between_tests_b():
     assert len(REGISTRY) == 0
     counter('leak').add(1)
+
+
+def test_instrumented_jit_compile_telemetry(tmp_path):
+    """instrumented_jit attributes compiles to a label: miss + first
+    call wall + a compile.<label> span on the first call, a hit
+    counter on re-use."""
+    import jax.numpy as jnp
+    f = diagnostics.instrumented_jit(lambda x: x + 1, label='t.addone')
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        np.testing.assert_array_equal(
+            np.asarray(f(jnp.zeros(4))), np.ones(4))
+        f(jnp.zeros(4))                        # cached executable
+    snap = REGISTRY.snapshot()
+    assert snap['compile.t.addone.misses']['value'] == 1
+    assert snap['compile.t.addone.hits']['value'] == 1
+    assert snap['compile.t.addone.first_call_s']['count'] == 1
+    spans, _ = _spans(str(tmp_path))
+    comp = [s for s in spans if s['name'] == 'compile.t.addone']
+    assert len(comp) == 1
+    assert comp[0]['attrs'] == {'misses': 1}
+
+
+def test_instrumented_jit_inside_outer_trace():
+    """Under an outer jit the wrapper must pass straight through (no
+    host-side bookkeeping while staging)."""
+    import jax
+    import jax.numpy as jnp
+    inner = diagnostics.instrumented_jit(lambda x: x * 2,
+                                         label='t.inner')
+
+    @jax.jit
+    def outer(x):
+        return inner(x) + 1
+
+    np.testing.assert_array_equal(np.asarray(outer(jnp.ones(3))),
+                                  np.full(3, 3.0))
+    snap = REGISTRY.snapshot()
+    assert 'compile.t.inner.misses' not in snap
+    assert 'compile.t.inner.hits' not in snap
 
 
 # ---------------------------------------------------------------------------
@@ -291,6 +377,13 @@ def test_fftpower_acceptance_trace(tmp_path, cpu8):
     assert snap['paint.scatter.mpart_per_s']['count'] >= 1
     # device watermarks were sampled for the 8 virtual devices
     assert snap['device.cpu:0.live_bytes']['max'] > 0
+    # compile telemetry (ISSUE 2 acceptance): the binning program's
+    # compile is attributed by label, and the jax.monitoring hook
+    # timed the XLA compile stages
+    assert snap['compile.fftpower.binning.misses']['value'] >= 1
+    assert snap['compile.fftpower.binning.first_call_s']['count'] >= 1
+    assert snap['xla.compile.backend_s']['count'] >= 1
+    assert 'compile.fftpower.binning' in names
     # spans nest: the exchange happens inside the paint
     by = {s['name']: s for s in spans}
     assert by['exchange']['par'] == by['paint']['id']

@@ -29,9 +29,10 @@ def _run_single():
 
 
 @pytest.mark.slow
-def test_two_process_world_mesh_matches_single():
+def test_two_process_world_mesh_matches_single(tmp_path):
     port = 12357
-    env = dict(os.environ)
+    trace_dir = str(tmp_path / 'trace')
+    env = dict(os.environ, NBKIT_DIAGNOSTICS=trace_dir)
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, '127.0.0.1:%d' % port, '2',
@@ -67,6 +68,37 @@ def test_two_process_world_mesh_matches_single():
     assert ndev1 == 4
     np.testing.assert_allclose(results[0][1], total1, rtol=1e-5)
     np.testing.assert_allclose(results[0][2], p21, rtol=1e-4)
+
+    # fleet analysis over the REAL 2-process trace directory: the
+    # merged timeline must hold both worker pids with aligned clocks,
+    # the explicit barrier spans must anchor a straggler table, and
+    # the clean run must show no hung collectives
+    from nbodykit_tpu.diagnostics.analyze import (analyze,
+                                                  render_analysis)
+    res = analyze(trace_dir)
+    worker_pids = {p.pid for p in procs}
+    assert set(res['pids']) == worker_pids
+    assert res['nprocs'] == 2
+    timeline_pids = {r['pid'] for r in res['timeline']}
+    assert timeline_pids == worker_pids and res['timeline']
+    assert res['anchors_used'] >= 2          # barrier pair at least
+    assert 'barrier' in res['stragglers']['per_name']
+    assert not res['hangs']['hung_collectives']
+    cp = res['critical_path']
+    assert cp['wall_s'] > 0 and 'paint' in cp['phases']
+    text = render_analysis(res)
+    assert 'straggler report' in text and 'critical path' in text
+
+    # the CLI form the acceptance criterion names
+    r = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.diagnostics',
+         '--analyze', trace_dir],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(HERE))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert 'merged timeline' in r.stdout
+    for p in worker_pids:
+        assert str(p) in r.stdout
 
 
 @pytest.mark.slow
