@@ -39,8 +39,10 @@ def _cylinder_sweep(grid, rank_s, central_s, los, rperp, rpar):
     satellites to the first central in rank order, not the nearest
     (cgm.py sorts pairs by rank and takes the head)."""
     ci = grid.cell_of(grid.pos_s)
-    rp2 = jnp.asarray(float(rperp) ** 2, grid.pos_s.dtype)
-    rpar_j = jnp.asarray(float(rpar), grid.pos_s.dtype)
+    # rperp/rpar are static host config closed over by the jitted
+    # sweep lambda, never traced values — audited, safe to coerce
+    rp2 = jnp.asarray(float(rperp) ** 2, grid.pos_s.dtype)  # nbkl: disable=NBK401
+    rpar_j = jnp.asarray(float(rpar), grid.pos_s.dtype)  # nbkl: disable=NBK401
     los_j = jnp.asarray(los, grid.pos_s.dtype)
     n = grid.pos_s.shape[0]
 
@@ -85,7 +87,10 @@ def _cgm_classify(pos, rank, box, rperp, rpar, los, periodic, mesh):
                               periodic=periodic)
         rank_s = jnp.asarray(rank)[grid.order]
 
-        sweep = jax.jit(lambda c: _cylinder_sweep(
+        # constructed once per classify call, then reused across every
+        # Jacobi round of the while loop below; the closure is
+        # grid-data-dependent so it cannot be hoisted to module scope
+        sweep = jax.jit(lambda c: _cylinder_sweep(  # nbkl: disable=NBK202
             grid, rank_s, c, los, rperp, rpar))
         central = jnp.ones(N, bool)
         while True:
@@ -131,7 +136,9 @@ def _cgm_classify(pos, rank, box, rperp, rpar, los, periodic, mesh):
             haloid_s)
         return sat_l, haloid_out
 
-    round_fn = jax.jit(jax.shard_map(
+    # one construction per classify call, reused across the rank-round
+    # while loop; mesh/shape-dependent closure — cannot hoist
+    round_fn = jax.jit(jax.shard_map(  # nbkl: disable=NBK202
         round_local, mesh=mesh,
         in_specs=(P(AXIS, None),) + (P(AXIS),) * 5,
         out_specs=(P(AXIS), P(AXIS))))

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ...binned_statistic import BinnedStatistic
-from ...utils import JSONEncoder, JSONDecoder
+from ...utils import JSONEncoder, JSONDecoder, working_dtype
 from ..fftpower import project_to_basis, _find_unique_edges
 from ...base.mesh import Field
 from .catalogmesh import FKPCatalogMesh
@@ -224,13 +224,16 @@ class ConvolvedFFTPower(object):
         H = pm.cellsize
         offset = self.attrs['BoxCenter'] - pm.BoxSize / 2.0 + 0.5 * H
 
-        xvec = [(jnp.arange(N0, dtype=jnp.float64) * H[0]
+        # best-available precision, decided explicitly (NBK301): f8
+        # under x64, f4 on TPU where jnp.float64 would demote silently
+        _f8 = working_dtype('f8')
+        xvec = [(jnp.arange(N0, dtype=_f8) * H[0]
                  + offset[0]).reshape(N0, 1, 1),
-                (jnp.arange(N1, dtype=jnp.float64) * H[1]
+                (jnp.arange(N1, dtype=_f8) * H[1]
                  + offset[1]).reshape(1, N1, 1),
-                (jnp.arange(N2, dtype=jnp.float64) * H[2]
+                (jnp.arange(N2, dtype=_f8) * H[2]
                  + offset[2]).reshape(1, 1, N2)]
-        kvec = pm.k_list(dtype=jnp.float64, full=use_c2c)
+        kvec = pm.k_list(dtype=_f8, full=use_c2c)
 
         cols = ['k'] + ['power_%d' % l for l in
                         sorted(self.attrs['poles'])] + ['modes']
@@ -266,7 +269,11 @@ class ConvolvedFFTPower(object):
                     Aell = Aell + ck * Ylm(ku[0], ku[1], ku[2])
                 Aell = transfer(w_circ, Aell)
                 return Aell * (4 * np.pi * volume)
-            return jax.jit(prog)
+            # one program per ell BY DESIGN: each executes exactly
+            # once, and memoizing across run() calls would pin the
+            # fused Ylm/unit-vector constants (~GBs at Nmesh=1024) in
+            # HBM for the life of the process
+            return jax.jit(prog)   # nbkl: disable=NBK202
 
         proj_result = None
         for ell in poles[1:]:

@@ -26,7 +26,7 @@ from ..base.catalog import CatalogSourceBase
 from ..base.mesh import MeshSource, Field, FieldMesh
 from ..binned_statistic import BinnedStatistic
 from ..diagnostics import NULL_SPAN, instrumented_jit, span_eager
-from ..utils import JSONEncoder, JSONDecoder, as_numpy
+from ..utils import JSONEncoder, JSONDecoder, as_numpy, working_dtype
 
 
 def _legendre_all(ells, mu):
@@ -92,15 +92,20 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
 
     N0, N1, N2 = pm.shape_real
     L = pm.BoxSize
+    # best available precision for the mode coordinates/weights: f8
+    # under x64, f4 on TPU — an explicit demotion decision (NBK301)
+    # instead of a silent one (jnp.float64 with x64 off quietly
+    # returns f32)
+    _f8 = working_dtype('f8')
     if hermitian or full_complex:
-        kx, ky, kz = pm.k_list(dtype=jnp.float64, full=full_complex)
+        kx, ky, kz = pm.k_list(dtype=_f8, full=full_complex)
         coords = [kx * los[0], ky * los[1], kz * los[2]]
         x2fac = [kx ** 2, ky ** 2, kz ** 2]
         units = 2 * np.pi / np.asarray(L, dtype='f8')
         if full_complex:
-            w_b = jnp.ones((1, 1, 1), dtype=jnp.float64)
+            w_b = jnp.ones((1, 1, 1), dtype=_f8)
         else:
-            w_b = pm.hermitian_weights(dtype=jnp.float64)  # (1,1,nz)
+            w_b = pm.hermitian_weights(dtype=_f8)  # (1,1,nz)
     else:
         # real field: separation coordinates in fftfreq ordering
         rx = (jnp.fft.fftfreq(N0, d=1.0 / N0) * (L[0] / N0)
@@ -113,7 +118,7 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
         x2fac = [rx ** 2, ry ** 2, rz ** 2]
         units = np.asarray(L, dtype='f8') / np.asarray(
             [N0, N1, N2], dtype='f8')
-        w_b = jnp.ones((1, 1, 1), dtype=jnp.float64)
+        w_b = jnp.ones((1, 1, 1), dtype=_f8)
 
     # Exact-integer lattice binning for the no-x64 (TPU) regime. With
     # f64 unavailable, x^2 computed in f32 rounds differently from the
@@ -225,8 +230,10 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
 
         streams = [xw, muw, wf]
         legs = _legendre_all(_poles, mu)
-        vre = v_c.real.astype(jnp.float64).reshape(-1)
-        vim = (v_c.imag.astype(jnp.float64).reshape(-1)
+        # accumulate the spectrum in the widest dtype the backend has
+        # (f8 under x64, f4 on TPU) — explicit, not silently demoted
+        vre = v_c.real.astype(working_dtype('f8')).reshape(-1)
+        vim = (v_c.imag.astype(working_dtype('f8')).reshape(-1)
                if is_cplx else None)
         for iell, ell in enumerate(_poles):
             leg = jnp.broadcast_to(legs[iell], shape).reshape(-1)

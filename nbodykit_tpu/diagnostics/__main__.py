@@ -28,10 +28,19 @@ them all.
     python -m nbodykit_tpu.diagnostics --chrome PATH
         Export PATH to chrome_trace.json for ui.perfetto.dev.
 
+    python -m nbodykit_tpu.diagnostics --lint [ROOT]
+        Run the shard-safety static analyzer (nbodykit_tpu.lint) over
+        ROOT's package + multi-host worker, gated on
+        ROOT/lint_baseline.json when present.  Same exit contract as
+        the ``nbodykit-tpu-lint`` console script.
+
     python -m nbodykit_tpu.diagnostics --doctor [--trace DIR] [--root R]
-        Self-check + analyze + regress, one verdict block.  Installed
-        as the ``nbodykit-tpu-doctor`` console script;
-        ``--self-check-only`` restricts it to the trace round-trip.
+        Self-check + analyze + regress + lint, one verdict block.
+        Compile-cache misses for a jit label that also carries an open
+        NBK2xx lint finding are cross-linked: the static finding is
+        printed next to the runtime telemetry line.  Installed as the
+        ``nbodykit-tpu-doctor`` console script; ``--self-check-only``
+        restricts it to the trace round-trip.
 """
 
 import argparse
@@ -149,14 +158,72 @@ def run_regress(root, out=None, threshold=0.25,
     return gate_rc(history)
 
 
+def run_lint_cmd(root='.', out=None):
+    """--lint: the shard-safety analyzer over ROOT's lint surface,
+    gated on ROOT/lint_baseline.json when committed.  Exit contract ==
+    nbodykit-tpu-lint: 0 clean, 1 new findings."""
+    from .. import lint as lint_mod
+    out = out if out is not None else sys.stdout
+    targets = lint_mod.default_targets(root)
+    bl = os.path.join(root, 'lint_baseline.json')
+    argv = list(targets)
+    if os.path.exists(bl):
+        argv += ['--baseline', bl]
+    import contextlib
+    with contextlib.redirect_stdout(out):
+        return lint_mod.main(argv)
+
+
+def _lint_findings(root):
+    """(new, open_findings, jit_label_map) for the doctor; raises on a
+    broken baseline so the doctor reports it."""
+    from .. import lint as lint_mod
+    targets = lint_mod.default_targets(root)
+    bl = os.path.join(root, 'lint_baseline.json')
+    new, grandfathered, _ = lint_mod.run_lint(
+        targets, baseline_path=bl if os.path.exists(bl) else None)
+    return new, new + grandfathered, lint_mod.collect_jit_labels(targets)
+
+
+def _compile_miss_labels(trace):
+    """jit labels with observed cache misses: live registry counters
+    (``compile.<label>.misses``) merged with ``compile.<label>`` spans
+    found in the analyzed trace directory."""
+    from . import REGISTRY
+    labels = {}
+    for name, snap in REGISTRY.snapshot().items():
+        if name.startswith('compile.') and name.endswith('.misses') \
+                and snap.get('value'):
+            labels[name[len('compile.'):-len('.misses')]] = \
+                int(snap['value'])
+    if trace and os.path.exists(trace):
+        try:
+            from .analyze import load_processes
+            procs, _ = load_processes(trace)
+        except Exception:
+            procs = {}
+        for records in procs.values():
+            for r in records:
+                name = r.get('name', '')
+                if r.get('t') == 'span' and \
+                        name.startswith('compile.') and \
+                        name != 'compile.backend':
+                    lbl = name[len('compile.'):]
+                    labels[lbl] = labels.get(lbl, 0) + 1
+    return labels
+
+
 def run_doctor(trace=None, root='.', self_check_only=False,
                out=None, threshold=0.25, stale_hours=24.0):
-    """Self-check + analyze + regress, one verdict block.
+    """Self-check + analyze + regress + lint, one verdict block.
 
     Returns 0 (OK/WARN) or 1 (FAIL).  FAIL means the diagnostics stack
     itself is broken, a trace shows a hung collective or silent
-    process, or a committed bench record is malformed.  WARN covers
-    stale replays and regressions — loud, but not blocking.
+    process, a committed bench record is malformed, or the lint gate
+    has non-baselined findings.  WARN covers stale replays,
+    regressions, and compile-cache misses whose jit label carries an
+    open NBK2xx finding (the static/runtime cross-link) — loud, but
+    not blocking.
     """
     out = out if out is not None else sys.stdout
     lines, fail, warn = [], [], []
@@ -239,6 +306,47 @@ def run_doctor(trace=None, root='.', self_check_only=False,
             else:
                 lines.append('regress      OK: %s' % desc)
 
+    if root is not None and \
+            not os.path.isdir(os.path.join(root, 'nbodykit_tpu')):
+        lines.append('lint         SKIP: no nbodykit_tpu package '
+                     'under %s (pass the repo root as --root to lint)'
+                     % root)
+    elif root is not None:
+        open_nbk2, label_map = [], {}
+        try:
+            new, open_findings, label_map = _lint_findings(root)
+        except Exception as e:
+            fail.append('lint')
+            lines.append('lint         FAIL: %s' % e)
+        else:
+            open_nbk2 = [f for f in open_findings
+                         if f.code.startswith('NBK2')]
+            ngrand = len(open_findings) - len(new)
+            if new:
+                fail.append('lint')
+                lines.append('lint         FAIL: %d non-baselined '
+                             'finding(s) — run --lint %s for the '
+                             'listing' % (len(new), root))
+            else:
+                lines.append('lint         OK: 0 new findings '
+                             '(%d grandfathered in lint_baseline.json)'
+                             % ngrand)
+        # static/runtime cross-link: a jit label that missed the
+        # compile cache AND sits in a file with an open NBK2xx finding
+        # is almost certainly the finding biting at runtime
+        for label, nmiss in sorted(_compile_miss_labels(trace).items()):
+            site = label_map.get(label)
+            related = [f for f in open_nbk2
+                       if site and f.path == site[0]]
+            if not related:
+                continue
+            warn.append('compile')
+            f0 = related[0]
+            lines.append('compile      WARN: label %r missed the jit '
+                         'cache %dx — open %s at %s:%d: %s'
+                         % (label, nmiss, f0.code, f0.path, f0.line,
+                            f0.message))
+
     verdict = 'FAIL (%s)' % ', '.join(fail) if fail else \
         ('WARN (%s)' % ', '.join(warn) if warn else 'OK')
     out.write('== nbodykit-tpu doctor ==\n')
@@ -280,6 +388,11 @@ def main(argv=None):
                          'headline is verdicted stale (default 24)')
     ap.add_argument('--chrome', metavar='TRACE',
                     help='export a trace to chrome_trace.json')
+    ap.add_argument('--lint', metavar='ROOT', nargs='?', const='.',
+                    default=None,
+                    help='run the shard-safety static analyzer over '
+                         "ROOT's package (default .), gated on "
+                         'ROOT/lint_baseline.json when present')
     ap.add_argument('--doctor', action='store_true',
                     help='self-check + analyze + regress, one verdict '
                          'block')
@@ -313,6 +426,8 @@ def main(argv=None):
     if args.regress is not None:
         return run_regress(args.regress, threshold=args.threshold,
                            stale_hours=args.stale_hours)
+    if args.lint is not None:
+        return run_lint_cmd(args.lint)
     if args.chrome:
         from . import export_chrome_trace
         print(export_chrome_trace(args.chrome))

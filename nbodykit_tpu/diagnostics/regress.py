@@ -235,6 +235,33 @@ def load_caches(root, stale_hours=24.0, now=None):
     return out
 
 
+def lint_summary(root):
+    """Current shard-safety lint counts for the round record: the
+    committed ``lint_baseline.json`` is expected to *shrink* over PRs,
+    so the count is tracked in BENCH_HISTORY.json like a bench metric.
+    Returns None when ``root`` holds no lintable package; never raises
+    (a broken linter must not wedge the bench gate — the error string
+    is recorded instead)."""
+    if not os.path.isdir(os.path.join(root, 'nbodykit_tpu')):
+        return None
+    try:
+        from .. import lint as lint_mod
+        targets = lint_mod.default_targets(root)
+        bl = os.path.join(root, 'lint_baseline.json')
+        new, grandfathered, unused = lint_mod.run_lint(
+            targets, baseline_path=bl if os.path.exists(bl) else None)
+        return {
+            'findings': len(new) + len(grandfathered),
+            'new': len(new),
+            'baselined': len(grandfathered),
+            'stale_baseline_entries': len(unused),
+            'baseline': os.path.basename(bl)
+            if os.path.exists(bl) else None,
+        }
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+
+
 def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
                   now=None, write=True):
     """Assemble + (atomically) write ``BENCH_HISTORY.json``; returns
@@ -249,6 +276,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'threshold': threshold,
         'stale_hours': stale_hours,
         'rounds': entries,
+        'lint': lint_summary(root),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
                            if e.get('verdict') == v)
@@ -294,6 +322,18 @@ def render_regress(history):
              ', %d older than the stale bar (fine for a cache; loud '
              'only when replayed as a headline)' % len(stale)
              if stale else ''))
+    lint = history.get('lint')
+    if lint is not None:
+        if 'error' in lint:
+            w('  lint: unavailable (%s)' % lint['error'])
+        else:
+            w('  lint: %d finding(s) — %d new, %d baselined%s'
+              % (lint['findings'], lint['new'], lint['baselined'],
+                 ', %d stale baseline entr%s to prune'
+                 % (lint['stale_baseline_entries'],
+                    'y' if lint['stale_baseline_entries'] == 1
+                    else 'ies')
+                 if lint.get('stale_baseline_entries') else ''))
     s = history['summary']
     w('verdicts: %s' % '  '.join('%s=%d' % (k, n)
                                  for k, n in s.items() if n))

@@ -1,0 +1,45 @@
+"""nbodykit_tpu.lint — ``nbkl``, the TPU/JAX shard-safety static
+analyzer.
+
+nbodykit's correctness invariant — every rank executes the same
+collective program — carries over to the shard_map/psum substrate,
+where the failure modes are a hung fleet (rank-dependent collective),
+a recompile storm (jit cache busters), silent f32 demotion (TPU has no
+f64), and trace-time host ops frozen into the compiled program.  PR 2
+gave those *runtime* detection (diagnostics/analyze.py hung-collective
+tables, metrics.py ``xla.cache.*`` telemetry); this package is the
+*static* half: the same hazards caught at lint time, before anything
+runs.
+
+Rule families (full catalog: ``nbodykit-tpu-lint --list-rules``,
+docs/LINT.md):
+
+=======  ==========================================================
+NBK1xx   collectives — axis_name/shard_map mismatches, rank-gated
+         collectives (the static form of the hung-collective bug)
+NBK2xx   compile hygiene — jit in loops, per-call jit of lambdas/
+         closures, unhashable static args (the ``xla.cache.misses``
+         storms)
+NBK3xx   precision — float64 reaching jax unguarded, int32
+         flattened-index overflow
+NBK4xx   trace safety — ``.item()``/``float()``/``np.asarray`` /
+         ``time.time()``/``np.random.*`` inside traced code
+=======  ==========================================================
+
+Workflow: ``nbodykit-tpu-lint --baseline lint_baseline.json`` exits
+nonzero only on findings not grandfathered in the committed baseline;
+inline ``# nbkl: disable=NBKxxx`` suppresses a single audited site.
+The package is stdlib-only (pure AST — no project code is imported or
+executed).
+"""
+
+from .rules import RULES, Finding, run_rules  # noqa: F401
+from .scopes import ModuleContext  # noqa: F401
+from .walker import (canonical_path, collect_jit_labels,  # noqa: F401
+                     default_targets, iter_target_files, lint_paths,
+                     lint_source)
+from .baseline import (apply_baseline, build_baseline,  # noqa: F401
+                       load_baseline, write_baseline)
+from .report import (family_of, render_findings,  # noqa: F401
+                     render_json, render_summary, summarize_findings)
+from .cli import main, run_lint  # noqa: F401

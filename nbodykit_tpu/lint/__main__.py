@@ -1,0 +1,9 @@
+"""``python -m nbodykit_tpu.lint`` — same surface as the
+``nbodykit-tpu-lint`` console script (cli.py)."""
+
+import sys
+
+from .cli import main
+
+if __name__ == '__main__':
+    sys.exit(main())

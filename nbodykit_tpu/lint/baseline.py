@@ -1,0 +1,134 @@
+"""Grandfathered-finding baseline for the shard-safety linter.
+
+The gate contract: ``nbodykit-tpu-lint --baseline lint_baseline.json``
+exits 0 as long as no finding exists that is NOT in the committed
+baseline.  Existing findings are grandfathered (each with an audit
+note), so the rule set can land strict without a big-bang cleanup —
+and the baseline is expected to *shrink* over PRs (regress.py tracks
+the count in BENCH_HISTORY.json like a bench metric).
+
+Matching is by **fingerprint** — ``(code, canonical path, normalized
+source-line text)`` with a count — not by line number, so unrelated
+edits above a grandfathered finding do not invalidate the baseline.
+Two identical findings on identical lines share one entry with
+``count: 2``.
+"""
+
+import collections
+import json
+import os
+import tempfile
+import time
+
+
+def atomic_write(path, text):
+    """tmp + rename in the destination directory — same crash-safety
+    discipline as diagnostics/trace.py, duplicated so the lint package
+    stays stdlib-only (importable without jax)."""
+    d = os.path.dirname(os.path.abspath(path)) or '.'
+    fd, tmp = tempfile.mkstemp(prefix='.nbkl-', dir=d)
+    try:
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def fingerprint(finding, line_text=''):
+    """The stable identity of a finding across line-number drift."""
+    return (finding.code, finding.path, ' '.join(line_text.split()))
+
+
+def _line_text(finding, sources):
+    lines = sources.get(finding.path)
+    if lines and 1 <= finding.line <= len(lines):
+        return lines[finding.line - 1]
+    return ''
+
+
+def load_baseline(path):
+    """Parse a baseline file into {fingerprint: entry}.  A missing file
+    is an empty baseline; a malformed one raises ValueError (the gate
+    must not silently pass on a corrupt baseline)."""
+    try:
+        with open(path, encoding='utf-8') as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    if not isinstance(data, dict) or \
+            not isinstance(data.get('findings'), list):
+        raise ValueError('malformed baseline %s: expected '
+                         '{"findings": [...]}' % path)
+    out = {}
+    for e in data['findings']:
+        key = (e.get('code', ''), e.get('path', ''),
+               ' '.join(str(e.get('line_text', '')).split()))
+        e.setdefault('count', 1)
+        out[key] = e
+    return out
+
+
+def apply_baseline(findings, baseline, sources=None):
+    """Split findings into (new, grandfathered, unused_entries).
+
+    ``sources`` maps canonical path -> source line list (for
+    fingerprinting); findings whose file text is unavailable
+    fingerprint on an empty line text.
+    ``unused_entries`` are baseline entries matching nothing anymore —
+    fixed findings whose entry should be dropped (reported, not fatal).
+    """
+    sources = sources or {}
+    remaining = {k: e.get('count', 1) for k, e in baseline.items()}
+    new, grandfathered = [], []
+    for f in findings:
+        key = fingerprint(f, _line_text(f, sources))
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            grandfathered.append(f)
+        else:
+            new.append(f)
+    unused = [baseline[k] for k, n in remaining.items() if n > 0
+              and n == baseline[k].get('count', 1)]
+    return new, grandfathered, unused
+
+
+def build_baseline(findings, sources=None, notes=None):
+    """The JSON document grandfathering the given findings.  ``notes``
+    maps (code, path) or code to an audit comment stored with each
+    entry."""
+    sources = sources or {}
+    notes = notes or {}
+    counts = collections.OrderedDict()
+    for f in findings:
+        key = fingerprint(f, _line_text(f, sources))
+        if key not in counts:
+            counts[key] = {'finding': f, 'count': 0}
+        counts[key]['count'] += 1
+    entries = []
+    for (code, path, line_text), info in counts.items():
+        f = info['finding']
+        entry = {
+            'code': code, 'path': path, 'line_text': line_text,
+            'count': info['count'], 'message': f.message,
+        }
+        note = notes.get((code, path)) or notes.get(code)
+        if note:
+            entry['note'] = note
+        entries.append(entry)
+    return {
+        'version': 1,
+        'generated_at': time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                      time.gmtime()),
+        'tool': 'nbodykit-tpu-lint',
+        'findings': entries,
+    }
+
+
+def write_baseline(doc, path):
+    atomic_write(path, json.dumps(doc, indent=1) + '\n')
+    return path

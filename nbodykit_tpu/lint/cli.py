@@ -1,0 +1,122 @@
+"""The ``nbodykit-tpu-lint`` command.
+
+    nbodykit-tpu-lint                      # lint the default surface
+    nbodykit-tpu-lint nbodykit_tpu/ tests/_multihost_worker.py
+    nbodykit-tpu-lint --baseline lint_baseline.json
+    nbodykit-tpu-lint --write-baseline lint_baseline.json
+    nbodykit-tpu-lint --select NBK1,NBK4 --json
+
+Exit codes: 0 — no non-baselined findings; 1 — new findings (the CI
+gate); 2 — usage / IO error.  ``scripts/smoke.sh`` and
+``tests/test_lint.py`` both run the baseline-gated form, so a new
+hazard cannot land silently.
+"""
+
+import argparse
+import os
+import sys
+
+from . import baseline as baseline_mod
+from .report import (render_findings, render_json, render_rule_catalog,
+                     render_summary)
+from .walker import canonical_path, default_targets, iter_target_files, \
+    lint_paths
+
+
+def _sources_for(paths):
+    """canonical path -> source lines, for baseline fingerprints."""
+    out = {}
+    for p in iter_target_files(paths):
+        try:
+            with open(p, encoding='utf-8') as f:
+                out[canonical_path(p)] = f.read().splitlines()
+        except OSError:
+            pass
+    return out
+
+
+def run_lint(paths=None, baseline_path=None, select=None):
+    """Programmatic form of the CLI (used by the doctor, regress.py and
+    tests): returns ``(new, grandfathered, unused_entries)``."""
+    paths = list(paths) if paths else default_targets()
+    findings = lint_paths(paths, select=select)
+    if baseline_path:
+        base = baseline_mod.load_baseline(baseline_path)
+    else:
+        base = {}
+    sources = _sources_for(paths)
+    return baseline_mod.apply_baseline(findings, base, sources=sources)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='nbodykit-tpu-lint',
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('paths', nargs='*',
+                    help='files/directories to lint (default: the '
+                         'nbodykit_tpu package + '
+                         'tests/_multihost_worker.py)')
+    ap.add_argument('--baseline', metavar='FILE', default=None,
+                    help='grandfathered findings; only findings NOT in '
+                         'it fail the run')
+    ap.add_argument('--write-baseline', metavar='FILE', default=None,
+                    help='write the current findings as the new '
+                         'baseline and exit 0')
+    ap.add_argument('--select', default=None,
+                    help='comma-separated code prefixes to run '
+                         '(e.g. NBK1,NBK402)')
+    ap.add_argument('--json', action='store_true',
+                    help='machine-readable output')
+    ap.add_argument('--no-hints', action='store_true',
+                    help='omit the fix-hint lines')
+    ap.add_argument('--list-rules', action='store_true',
+                    help='print the rule catalog and exit')
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        sys.stdout.write(render_rule_catalog())
+        return 0
+
+    select = [s.strip().upper() for s in args.select.split(',')
+              if s.strip()] if args.select else None
+    paths = args.paths or default_targets()
+    for p in paths:
+        if not os.path.exists(p):
+            print('nbodykit-tpu-lint: no such path: %s' % p,
+                  file=sys.stderr)
+            return 2
+
+    findings = lint_paths(paths, select=select)
+    sources = _sources_for(paths)
+
+    if args.write_baseline:
+        doc = baseline_mod.build_baseline(findings, sources=sources)
+        baseline_mod.write_baseline(doc, args.write_baseline)
+        print('wrote %s: %d finding(s) grandfathered in %d entr%s'
+              % (args.write_baseline, len(findings),
+                 len(doc['findings']),
+                 'y' if len(doc['findings']) == 1 else 'ies'))
+        return 0
+
+    try:
+        base = baseline_mod.load_baseline(args.baseline) \
+            if args.baseline else {}
+    except ValueError as e:
+        print('nbodykit-tpu-lint: %s' % e, file=sys.stderr)
+        return 2
+    new, grandfathered, unused = baseline_mod.apply_baseline(
+        findings, base, sources=sources)
+
+    if args.json:
+        sys.stdout.write(render_json(new, grandfathered, unused))
+    else:
+        sys.stdout.write(render_findings(
+            new, show_hints=not args.no_hints))
+        sys.stdout.write(render_summary(
+            new, grandfathered, unused, baseline_path=args.baseline))
+    return 1 if new else 0
+
+
+if __name__ == '__main__':        # pragma: no cover - thin shim
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""Rendering for lint results: the flake8-style text listing, the
+machine-readable JSON, and the per-family summary."""
+
+import collections
+import json
+
+from .rules import RULES
+
+FAMILIES = collections.OrderedDict([
+    ('NBK1', 'collectives'),
+    ('NBK2', 'compile hygiene'),
+    ('NBK3', 'precision'),
+    ('NBK4', 'trace safety'),
+    ('NBK0', 'tool'),
+])
+
+
+def family_of(code):
+    return FAMILIES.get(code[:4], 'other')
+
+
+def summarize_findings(findings):
+    """Counts per code and per family."""
+    by_code = collections.Counter(f.code for f in findings)
+    by_family = collections.Counter(family_of(f.code)
+                                    for f in findings)
+    return {'total': len(findings),
+            'by_code': dict(sorted(by_code.items())),
+            'by_family': dict(by_family)}
+
+
+def render_findings(findings, show_hints=True):
+    """One line per finding, ``path:line:col CODE message``, with the
+    fix hint indented under it."""
+    out = []
+    for f in findings:
+        out.append('%s:%d:%d %s %s'
+                   % (f.path, f.line, f.col + 1, f.code, f.message))
+        if show_hints and f.hint:
+            out.append('    hint: %s' % f.hint)
+    return '\n'.join(out) + ('\n' if out else '')
+
+
+def render_summary(new, grandfathered, unused, baseline_path=None):
+    s = summarize_findings(new)
+    lines = []
+    if new:
+        lines.append(
+            '%d new finding(s): %s'
+            % (len(new), '  '.join('%s=%d' % kv for kv in
+                                   sorted(s['by_code'].items()))))
+    else:
+        lines.append('no new findings')
+    if grandfathered:
+        lines.append('%d grandfathered finding(s) matched the '
+                     'baseline%s' % (
+                         len(grandfathered),
+                         ' (%s)' % baseline_path if baseline_path
+                         else ''))
+    if unused:
+        lines.append('%d stale baseline entr%s no longer match%s '
+                     'anything — findings fixed; prune them:'
+                     % (len(unused),
+                        'y' if len(unused) == 1 else 'ies',
+                        'es' if len(unused) == 1 else ''))
+        for e in unused:
+            lines.append('    %s %s (%r)'
+                         % (e.get('code'), e.get('path'),
+                            (e.get('line_text') or '')[:48]))
+    return '\n'.join(lines) + '\n'
+
+
+def render_json(new, grandfathered, unused):
+    def enc(f):
+        return {'code': f.code, 'path': f.path, 'line': f.line,
+                'col': f.col, 'message': f.message, 'hint': f.hint,
+                'family': family_of(f.code)}
+    return json.dumps({
+        'new': [enc(f) for f in new],
+        'grandfathered': [enc(f) for f in grandfathered],
+        'stale_baseline_entries': unused,
+        'summary': summarize_findings(new),
+    }, indent=1) + '\n'
+
+
+def render_rule_catalog():
+    """--list-rules output: every registered code with its summary."""
+    out = []
+    fam = None
+    for code, (summary, _) in RULES.items():
+        f = family_of(code)
+        if f != fam:
+            fam = f
+            out.append('%s (%sxx)' % (fam, code[:4]))
+        out.append('  %s  %s' % (code, summary))
+    return '\n'.join(out) + '\n'

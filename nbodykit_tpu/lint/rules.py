@@ -1,0 +1,578 @@
+"""Rule registry for the shard-safety linter.
+
+Rule families mirror the failure classes the runtime diagnostics catch
+after the fact (diagnostics/analyze.py, metrics.py) — here they are
+caught at lint time:
+
+NBK1xx  collectives     the hang class: every rank must execute the
+                        same collective program
+NBK2xx  compile hygiene the recompile class: the ``xla.cache.*`` miss
+                        storms PR 2's telemetry made visible
+NBK3xx  precision       the silent-demotion class: float64 that TPU
+                        quietly turns into float32, i32 index overflow
+NBK4xx  trace safety    host ops that sync, re-trace or bake in a
+                        trace-time value
+
+Each rule is a generator over a :class:`ModuleContext` yielding
+:class:`Finding` with a precise location and a one-line fix hint.
+"""
+
+import ast
+import collections
+
+from .scopes import COLLECTIVES, COLLECTIVE_TAILS, JIT_FUNS, JIT_TAILS
+
+Finding = collections.namedtuple(
+    'Finding', ['code', 'path', 'line', 'col', 'message', 'hint'])
+
+# code -> (summary, rule function)
+RULES = collections.OrderedDict()
+
+
+def rule(code, summary):
+    def deco(fn):
+        RULES[code] = (summary, fn)
+        return fn
+    return deco
+
+
+def run_rules(ctx, select=None):
+    """All findings for one module, sorted by location."""
+    out = []
+    for code, (summary, fn) in RULES.items():
+        if select and not any(code.startswith(s) for s in select):
+            continue
+        out.extend(fn(ctx))
+    return sorted(out, key=lambda f: (f.line, f.col, f.code))
+
+
+def _finding(code, ctx, node, message, hint):
+    return Finding(code, ctx.path, getattr(node, 'lineno', 1),
+                   getattr(node, 'col_offset', 0), message, hint)
+
+
+def _fmt_token(tok):
+    kind, val = tok
+    return repr(val) if kind == 'str' else val
+
+
+# ---------------------------------------------------------------------------
+# NBK1xx — collectives
+
+
+@rule('NBK101', 'collective axis_name not bound by the enclosing '
+                'shard_map')
+def collective_axis_mismatch(ctx):
+    """A ``psum``/``all_gather``/... whose ``axis_name`` does not match
+    any axis the enclosing ``shard_map`` binds compiles on no backend —
+    or worse, resolves against an unrelated outer axis.  Only definite
+    mismatches fire: if either side fails to resolve statically the
+    call is skipped."""
+    for node in ast.walk(ctx.tree):
+        if not ctx.is_collective(node):
+            continue
+        bound = ctx.axes_at(node)
+        if not bound:
+            continue        # not in a (recognized) shard_map body
+        axis = ctx.collective_axis_arg(node)
+        if axis is None:
+            continue
+        toks = ctx.axis_tokens(axis)
+        if not toks:
+            continue        # dynamic axis expression: can't judge
+        # resolve both sides to comparable sets; a 'sym' token only
+        # matches the same symbol, a 'str' only the same string
+        if toks & bound:
+            continue
+        # mixed-kind pairs (symbol vs string) are unresolved, not
+        # mismatched — stay silent unless kinds allow a verdict
+        kinds_t = {k for k, _ in toks}
+        kinds_b = {k for k, _ in bound}
+        if kinds_t != kinds_b and not (kinds_t & kinds_b):
+            continue
+        q = ctx.call_name(node)
+        yield _finding(
+            'NBK101', ctx, node,
+            '%s over axis %s, but the enclosing shard_map binds %s'
+            % (q, '/'.join(sorted(_fmt_token(t) for t in toks)),
+               '/'.join(sorted(_fmt_token(t) for t in bound))),
+            'pass the axis name the shard_map in_specs bind (use one '
+            'shared AXIS constant, parallel/runtime.py style)')
+
+
+@rule('NBK102', 'collective under a rank-dependent branch')
+def rank_gated_collective(ctx):
+    """A collective executed only when ``jax.process_index() == 0``
+    (or any rank-derived condition) is the canonical hung-fleet bug:
+    the other ranks never enter the collective and everyone blocks.
+    The runtime form is caught after the fact by diagnostics/analyze.py
+    hung-collective detection; this is the static form."""
+    coll_funcs = ctx.functions_containing_collectives()
+    taint_cache = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.If, ast.IfExp)):
+            continue
+        scope = ctx.enclosing_scope(node)
+        if scope not in taint_cache:
+            taint_cache[scope] = ctx.rank_tainted_names(scope)
+        if not ctx.expr_rank_derived(node.test, taint_cache[scope]):
+            continue
+        bodies = [node.body, node.orelse] if isinstance(node, ast.If) \
+            else [[node.body], [node.orelse]]
+        for branch in bodies:
+            hit = None
+            for stmt in branch:
+                stmts = stmt if isinstance(stmt, list) else [stmt]
+                for s in stmts:
+                    for sub in ast.walk(s):
+                        if ctx.is_collective(sub):
+                            hit = sub
+                            break
+                        if isinstance(sub, ast.Call):
+                            callee = ctx._resolve_def(sub.func, sub)
+                            if callee in coll_funcs:
+                                hit = sub
+                                break
+                    if hit is not None:
+                        break
+                if hit is not None:
+                    break
+            if hit is not None:
+                yield _finding(
+                    'NBK102', ctx, hit,
+                    'collective reached only under a rank-dependent '
+                    'condition (test at line %d) — ranks that skip it '
+                    'hang the fleet' % node.test.lineno,
+                    'hoist the collective out of the branch; make '
+                    'rank-dependent work data-dependent (mask/weight) '
+                    'instead of control-dependent')
+
+
+# ---------------------------------------------------------------------------
+# NBK2xx — compile hygiene
+
+
+def _jit_calls(ctx):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                ctx.matches(ctx.call_name(node), JIT_FUNS, JIT_TAILS):
+            yield node
+
+
+@rule('NBK201', 'jit constructed inside a loop')
+def jit_in_loop(ctx):
+    """``jax.jit`` caches on the *wrapper object*: constructing it
+    inside a loop makes a fresh cache per iteration, so every
+    iteration recompiles — the ``xla.cache.misses`` storm pattern."""
+    for call in _jit_calls(ctx):
+        encl = ctx.enclosing_function(call)
+        if encl is not None and ctx.memoized(encl) and \
+                not ctx.in_loop(call, stop_at_function=True):
+            continue        # loop outside the memoized builder
+        if ctx.in_loop(call):
+            yield _finding(
+                'NBK201', ctx, call,
+                '%s constructed inside a loop: a new jit cache per '
+                'iteration, so every iteration recompiles'
+                % ctx.call_name(call),
+                'hoist the jit (and the function it wraps) out of the '
+                'loop, or cache the wrapped callable')
+
+
+@rule('NBK202', 'jit re-wrapping a per-call function object')
+def jit_of_local(ctx):
+    """A jit call *executed per invocation* of its enclosing function,
+    wrapping a lambda / locally-defined function, builds a fresh
+    function object (and a fresh jit cache) on every call — every call
+    site pays a compile.  Module-level jits of module-level functions
+    are the cached pattern and do not fire."""
+    for call in _jit_calls(ctx):
+        encl = ctx.enclosing_function(call)
+        if encl is None:
+            continue        # module level: constructed once
+        if ctx.memoized(encl):
+            continue        # lru_cache'd builder: the dfft.py pattern
+        if not call.args:
+            continue
+        arg = call.args[0]
+        local = isinstance(arg, (ast.Lambda, ast.Call))
+        if isinstance(arg, ast.Name):
+            fn = ctx._resolve_def(arg, call)
+            local = fn is not None and \
+                ctx.enclosing_function(fn) is not None
+        if local:
+            yield _finding(
+                'NBK202', ctx, call,
+                '%s wraps a function object re-created on every call '
+                'of %s() — each call gets an empty jit cache and '
+                'recompiles' % (ctx.call_name(call),
+                                getattr(encl, 'name', '<lambda>')),
+                'hoist the jitted callable to module scope, or memoize '
+                'it (dict / functools.lru_cache keyed on the static '
+                'config)')
+    # nested defs decorated with a jit inside a function body
+    for fn in ctx.functions:
+        if isinstance(fn, ast.Lambda) or \
+                ctx.enclosing_function(fn) is None:
+            continue
+        if ctx.memoized(ctx.enclosing_function(fn)):
+            continue
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if ctx.matches(ctx.qual(target), JIT_FUNS, JIT_TAILS):
+                yield _finding(
+                    'NBK202', ctx, dec,
+                    '@%s on a def nested inside %s(): re-jitted per '
+                    'call' % (ctx.qual(target) or 'jit',
+                              getattr(ctx.enclosing_function(fn),
+                                      'name', '?')),
+                    'hoist the decorated function to module scope, or '
+                    'memoize the wrapper')
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+               ast.DictComp, ast.SetComp)
+
+
+def _static_positions(call):
+    """(positions, names) declared static by a jit call, as far as they
+    are literal."""
+    positions, names = set(), set()
+    for kw in call.keywords:
+        if kw.arg == 'static_argnums':
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    positions.add(v.value)
+        elif kw.arg == 'static_argnames':
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    names.add(v.value)
+    return positions, names
+
+
+@rule('NBK203', 'unhashable value bound to a static jit argument')
+def unhashable_static_arg(ctx):
+    """Static jit arguments key the compile cache by value, so they
+    must be hashable: a list/dict/set there raises at call time (newer
+    jax) or poisons the cache.  Checks literal call sites of jitted
+    wrappers and the wrapped function's defaults."""
+    wrappers = {}       # local wrapper name -> (positions, names)
+    for call in _jit_calls(ctx):
+        positions, names = _static_positions(call)
+        if not positions and not names:
+            continue
+        # defaults of the wrapped def
+        if call.args and isinstance(call.args[0], ast.Name):
+            fn = ctx._resolve_def(call.args[0], call)
+            if fn is not None and not isinstance(fn, ast.Lambda):
+                a = fn.args
+                params = [p.arg for p in a.posonlyargs + a.args]
+                ndef = len(a.defaults)
+                for i, d in enumerate(a.defaults):
+                    pos = len(params) - ndef + i
+                    pname = params[pos] if pos < len(params) else None
+                    if (pos in positions or pname in names) and \
+                            isinstance(d, _UNHASHABLE):
+                        yield _finding(
+                            'NBK203', ctx, d,
+                            'static argument %r of the jitted %s() '
+                            'defaults to an unhashable %s'
+                            % (pname or pos, fn.name,
+                               type(d).__name__.lower()),
+                            'use a tuple / frozenset (hashable) for '
+                            'static argument values')
+        # record wrapper assignment for call-site checking
+        parent = ctx.parents.get(call)
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    wrappers[t.id] = (positions, names)
+        elif isinstance(parent, ast.Call) and parent.func is call:
+            # immediately-invoked: jit(f, static_argnums=..)(args)
+            yield from _check_static_call(ctx, parent, positions,
+                                          names)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in wrappers:
+            positions, names = wrappers[node.func.id]
+            yield from _check_static_call(ctx, node, positions, names)
+
+
+def _check_static_call(ctx, call, positions, names):
+    for i, a in enumerate(call.args):
+        if i in positions and isinstance(a, _UNHASHABLE):
+            yield _finding(
+                'NBK203', ctx, a,
+                'unhashable %s passed in static position %d of a '
+                'jitted call' % (type(a).__name__.lower(), i),
+                'pass a tuple / frozenset; static args key the '
+                'compile cache by value')
+    for kw in call.keywords:
+        if kw.arg in names and isinstance(kw.value, _UNHASHABLE):
+            yield _finding(
+                'NBK203', ctx, kw.value,
+                'unhashable %s passed for static argument %r of a '
+                'jitted call' % (type(kw.value).__name__.lower(),
+                                 kw.arg),
+                'pass a tuple / frozenset; static args key the '
+                'compile cache by value')
+
+
+# ---------------------------------------------------------------------------
+# NBK3xx — precision
+
+
+_F64_STRINGS = {'f8', 'float64', '<f8', '>f8', '=f8', 'double', 'd'}
+_F64_ATTRS = {'numpy.float64', 'jax.numpy.float64', 'numpy.double',
+              'jax.numpy.double'}
+
+
+def _is_f64_token(ctx, node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _F64_STRINGS
+    q = ctx.qual(node)
+    return q in _F64_ATTRS
+
+
+def _x64_guarded(ctx, node):
+    """True when the f64 token sits under an explicit x64-capability
+    test (``jnp.float64 if jax.config.jax_enable_x64 else ...``) — the
+    audited pattern, not a silent demotion."""
+    n = node
+    while n is not None:
+        if isinstance(n, ast.IfExp) and \
+                'x64' in ast.dump(n.test):
+            return True
+        if isinstance(n, ast.If) and 'x64' in ast.dump(n.test):
+            return True
+        n = ctx.parents.get(n)
+    return False
+
+
+@rule('NBK301', 'float64 dtype reaching jax on a backend that '
+                'silently demotes')
+def float64_in_jax(ctx):
+    """TPU has no f64 ALU: with x64 off, a ``jnp.float64`` request is
+    *silently* served as f32 — results drift with no error.  Fires on
+    f64 dtype tokens passed to jnp calls or appearing inside traced
+    code, unless the site is explicitly x64-guarded or routed through
+    utils.working_dtype."""
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = ctx.call_name(node) or ''
+        if q.rsplit('.', 1)[-1] == 'working_dtype':
+            continue    # the sanctioned escape hatch (utils.py):
+            # demotes explicitly when x64 is off
+        is_jnp = q.startswith('jax.numpy.') or q.startswith('jax.lax.')
+        is_astype = q.rsplit('.', 1)[-1] == 'astype'
+        candidates = []
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if _is_f64_token(ctx, a):
+                candidates.append(a)
+        if not candidates:
+            continue
+        traced = ctx.is_traced(node)
+        if not (is_jnp or (is_astype and traced) or traced):
+            continue
+        for a in candidates:
+            if id(a) in seen or _x64_guarded(ctx, a):
+                continue
+            seen.add(id(a))
+            yield _finding(
+                'NBK301', ctx, a,
+                'float64 dtype %s %s — TPU serves this as f32 '
+                'silently when x64 is off'
+                % (ast.unparse(a) if hasattr(ast, 'unparse')
+                   else 'literal',
+                   'inside traced code' if traced
+                   else 'passed to %s' % q),
+                'route through utils.working_dtype("f8") or guard on '
+                'jax.config.jax_enable_x64 so the demotion is a '
+                'decision, not an accident')
+
+
+_I32_STRINGS = {'i4', 'int32', '<i4', '>i4', '=i4'}
+_I32_ATTRS = {'numpy.int32', 'jax.numpy.int32'}
+
+
+def _mentions_i32(ctx, node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and \
+                isinstance(sub.value, str) and \
+                sub.value in _I32_STRINGS:
+            return True
+        if ctx.qual(sub) in _I32_ATTRS:
+            return True
+    return False
+
+
+def _chained_mult(node):
+    """A multiply whose operands contain another multiply/add chain —
+    the flattened-index shape ``(a*n + b)*m + c``."""
+    if not (isinstance(node, ast.BinOp) and
+            isinstance(node.op, ast.Mult)):
+        return False
+    for side in (node.left, node.right):
+        for sub in ast.walk(side):
+            if isinstance(sub, ast.BinOp) and \
+                    isinstance(sub.op, (ast.Mult, ast.Add)):
+                return True
+    return False
+
+
+@rule('NBK302', 'int32 flattened-index arithmetic that can overflow')
+def int32_index_overflow(ctx):
+    """Hash/flat-index chains like ``(i*n1 + j)*n2 + k`` computed in
+    int32 overflow silently past 2^31 elements — the gridhash /
+    radix-key hazard.  Fires when an explicit int32 cast appears in the
+    same expression as a chained index multiply."""
+    reported = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Assign, ast.Return, ast.Expr,
+                                 ast.AugAssign, ast.AnnAssign)):
+            continue
+        value = getattr(node, 'value', None)
+        if value is None or not _mentions_i32(ctx, value):
+            continue
+        for sub in ast.walk(value):
+            if _chained_mult(sub) and id(sub) not in reported:
+                reported.add(id(sub))
+                yield _finding(
+                    'NBK302', ctx, sub,
+                    'chained index multiply computed alongside an '
+                    'explicit int32 cast — overflows silently past '
+                    '2**31 total elements',
+                    'derive the index dtype from the element-count '
+                    'bound (devicehash.py pattern: i32 only when '
+                    'prod(ncell) < 2**31) or cast to int64 for the '
+                    'flattening')
+                break
+
+
+# ---------------------------------------------------------------------------
+# NBK4xx — trace safety
+
+
+_SYNC_METHODS = {'item', 'tolist', 'block_until_ready'}
+_SYNC_BUILTINS = {'float', 'int', 'bool', 'complex'}
+_SHAPE_ATTRS = {'shape', 'ndim', 'dtype', 'size', 'itemsize'}
+_IMPURE_CALLS = ('time.time', 'time.perf_counter', 'time.monotonic',
+                 'time.process_time', 'datetime.datetime.now',
+                 'datetime.datetime.utcnow')
+
+
+def _only_shape_mentions(ctx, node, tainted):
+    """True when every tainted-name mention in the expression sits
+    under a static attribute (``x.shape`` etc.) — shape math is
+    trace-safe."""
+    any_mention = False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in tainted and \
+                isinstance(sub.ctx, ast.Load):
+            any_mention = True
+            parent = ctx.parents.get(sub)
+            ok = False
+            while isinstance(parent, ast.Attribute):
+                if parent.attr in _SHAPE_ATTRS:
+                    ok = True
+                    break
+                parent = ctx.parents.get(parent)
+            if not ok:
+                return False
+    return any_mention
+
+
+@rule('NBK401', 'host synchronization on a traced value')
+def host_sync_in_trace(ctx):
+    """``.item()`` / ``float()`` / ``np.asarray()`` on a traced value
+    raises ConcretizationError inside jit — or, under eager shard_map
+    per-device code, forces a device sync per call.  Fires only inside
+    functions the scope tracker marks as traced."""
+    taint_cache = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = ctx.enclosing_function(node)
+        if fn is None or not ctx.is_traced(node):
+            continue
+        if fn not in taint_cache:
+            taint_cache[fn] = ctx.param_tainted_names(fn)
+        tainted = taint_cache[fn]
+        # method sync: anything.item() in traced code
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS and not node.args:
+            yield _finding(
+                'NBK401', ctx, node,
+                '.%s() inside traced code forces a host sync (and '
+                'raises under jit)' % node.func.attr,
+                'keep the value on device; reduce with jnp and read '
+                'the result outside the traced function')
+            continue
+        q = ctx.call_name(node) or ''
+        tail = q.rsplit('.', 1)[-1]
+        # builtin coercion of a traced value
+        if q in _SYNC_BUILTINS and node.args:
+            a = node.args[0]
+            mentions = any(isinstance(s, ast.Name) and
+                           s.id in tainted and
+                           isinstance(s.ctx, ast.Load)
+                           for s in ast.walk(a))
+            if mentions and not _only_shape_mentions(ctx, a, tainted):
+                yield _finding(
+                    'NBK401', ctx, node,
+                    '%s() applied to a traced value — raises '
+                    'ConcretizationTypeError under jit' % q,
+                    'stay in jnp (jnp.float32(x) / astype) or move '
+                    'the coercion outside the traced function')
+            continue
+        # numpy materialization of a traced value
+        if q.startswith('numpy.') and tail in ('asarray', 'array',
+                                               'copy', 'ascontiguousarray'):
+            if node.args:
+                a = node.args[0]
+                mentions = any(isinstance(s, ast.Name) and
+                               s.id in tainted and
+                               isinstance(s.ctx, ast.Load)
+                               for s in ast.walk(a))
+                if mentions and not _only_shape_mentions(ctx, a,
+                                                         tainted):
+                    yield _finding(
+                        'NBK401', ctx, node,
+                        'np.%s() on a traced value pulls it to host '
+                        '(raises under jit)' % tail,
+                        'use jnp.%s, or hoist the host conversion out '
+                        'of the traced function' % tail)
+
+
+@rule('NBK402', 'impure host op baked into a trace')
+def impure_host_op_in_trace(ctx):
+    """``time.time()`` / ``np.random.*`` inside traced code runs once
+    at trace time: the \"random\" draw or timestamp is a compile-time
+    constant replayed on every execution — and differs per rank,
+    which desynchronizes collective programs."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not ctx.is_traced(node):
+            continue
+        q = ctx.call_name(node) or ''
+        impure = q in _IMPURE_CALLS or \
+            q.startswith('numpy.random.') or \
+            q.startswith('random.')
+        if impure:
+            yield _finding(
+                'NBK402', ctx, node,
+                '%s() inside traced code evaluates once at trace time '
+                '— a frozen constant, different per rank' % q,
+                'use jax.random with an explicit key (rng.py), or '
+                'compute host values before entering the traced '
+                'function')

@@ -1,0 +1,539 @@
+"""Scope, trace-context and mesh-axis tracking for the shard-safety
+linter.
+
+Everything the rules (rules.py) ask about a module is answered here,
+from one AST pass plus a small fixpoint:
+
+- **alias resolution** — ``jnp.asarray`` -> ``jax.numpy.asarray``,
+  ``from jax import lax; lax.psum`` -> ``jax.lax.psum`` — so rules
+  match on canonical dotted names regardless of import spelling;
+- **traced-context marking** — which function bodies execute *under a
+  jax trace*: functions passed to (or decorated with) ``jax.jit`` /
+  ``shard_map`` / ``lax.scan`` / ``vmap`` / friends, their nested
+  defs, and (transitively, same module) the local functions they call.
+  The NBK3xx/NBK4xx rules only fire inside these;
+- **shard_map axis binding** — the axis names a shard_map body may
+  legally pass to collectives, extracted from the ``in_specs`` /
+  ``out_specs`` PartitionSpecs (string literals, or names resolved
+  through the module / project constant table).  Bodies called from
+  several shard_maps get the union; callees inherit the caller's axes;
+- **rank taint** — names derived from ``jax.process_index()`` (and
+  kin), per function scope, for the rank-dependent-collective rule.
+
+The analysis is deliberately *per-module* with a light cross-module
+constant table (so ``from ..parallel.runtime import AXIS`` resolves to
+``'dev'``): no imports are executed, no project code runs — the linter
+must be safe to point at broken code.
+"""
+
+import ast
+
+# ---------------------------------------------------------------------------
+# canonical name sets the rules match against
+
+# jax transforms whose function arguments execute under a trace
+TRANSFORMS = frozenset({
+    'jax.jit', 'jax.pjit', 'jax.pmap', 'jax.vmap', 'jax.grad',
+    'jax.value_and_grad', 'jax.jacfwd', 'jax.jacrev', 'jax.hessian',
+    'jax.checkpoint', 'jax.remat', 'jax.linearize', 'jax.vjp',
+    'jax.custom_jvp', 'jax.custom_vjp',
+    'jax.shard_map', 'jax.experimental.shard_map.shard_map',
+    'jax.experimental.pjit.pjit',
+    'jax.lax.scan', 'jax.lax.fori_loop', 'jax.lax.while_loop',
+    'jax.lax.cond', 'jax.lax.switch', 'jax.lax.map',
+    'jax.lax.associative_scan', 'jax.lax.custom_root',
+    'nbodykit_tpu.diagnostics.instrumented_jit',
+    'nbodykit_tpu.diagnostics.metrics.instrumented_jit',
+})
+# unqualified spellings accepted for the same transforms (tail match)
+TRANSFORM_TAILS = frozenset(
+    q.rsplit('.', 1)[-1] for q in TRANSFORMS) - {'map'}
+
+# the jit-like subset (compile-cache semantics; NBK2xx)
+JIT_FUNS = frozenset({
+    'jax.jit', 'jax.pjit', 'jax.pmap', 'jax.experimental.pjit.pjit',
+    'nbodykit_tpu.diagnostics.instrumented_jit',
+    'nbodykit_tpu.diagnostics.metrics.instrumented_jit',
+})
+JIT_TAILS = frozenset({'jit', 'pjit', 'pmap', 'instrumented_jit'})
+
+SHARD_MAP_NAMES = frozenset({
+    'jax.shard_map', 'jax.experimental.shard_map.shard_map'})
+
+# collective -> index of the positional axis_name argument
+COLLECTIVES = {
+    'jax.lax.psum': 1, 'jax.lax.pmean': 1, 'jax.lax.pmax': 1,
+    'jax.lax.pmin': 1, 'jax.lax.ppermute': 1, 'jax.lax.pshuffle': 1,
+    'jax.lax.all_gather': 1, 'jax.lax.all_to_all': 1,
+    'jax.lax.psum_scatter': 1, 'jax.lax.axis_index': 0,
+    'jax.lax.pbroadcast': 1,
+}
+COLLECTIVE_TAILS = frozenset(
+    q.rsplit('.', 1)[-1] for q in COLLECTIVES)
+
+# canonical names whose call result is rank-derived
+RANK_SOURCES = ('process_index', 'process_id', 'host_id')
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_SCOPE_NODES = _FUNC_NODES + (ast.Module,)
+
+
+def walk(node):
+    """ast.walk in deterministic (source) order."""
+    todo = [node]
+    while todo:
+        n = todo.pop(0)
+        yield n
+        todo[0:0] = list(ast.iter_child_nodes(n))
+
+
+def collect_module_constants(tree):
+    """Module-level ``NAME = <str|int|float>`` assignments."""
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.target, ast.Name):
+            out[node.target.id] = node.value.value
+    return out
+
+
+class ModuleContext(object):
+    """One parsed module plus every derived table the rules query."""
+
+    def __init__(self, path, source, project_constants=None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # upward links: node -> parent (ast has only downward links)
+        self.parents = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.aliases = {}       # local name -> canonical dotted prefix
+        self._collect_imports()
+        self.constants = collect_module_constants(self.tree)
+        self.project_constants = dict(project_constants or {})
+        # function tables
+        self.functions = [n for n in ast.walk(self.tree)
+                          if isinstance(n, _FUNC_NODES)]
+        self.defs_by_scope = {}     # scope node -> {name: def node}
+        for fn in self.functions:
+            if isinstance(fn, ast.Lambda):
+                continue
+            scope = self.enclosing_scope(fn)
+            self.defs_by_scope.setdefault(scope, {})[fn.name] = fn
+        self.traced = set()         # function nodes under a jax trace
+        self.shard_axes = {}        # function node -> set of axis tokens
+        self._mark_traced()
+        self._collective_funcs = None
+
+    # -- imports / canonical names -----------------------------------------
+
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split('.')[0]] = \
+                        a.name if a.asname else a.name.split('.')[0]
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ''
+                if node.level:      # relative: anchor at the package
+                    mod = 'nbodykit_tpu.' + mod if mod \
+                        else 'nbodykit_tpu'
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = \
+                        ('%s.%s' % (mod, a.name)) if mod else a.name
+
+    def qual(self, node):
+        """Canonical dotted name of a Name/Attribute chain, aliases
+        expanded ('jnp.sum' -> 'jax.numpy.sum'); None when the chain
+        bottoms out in a call/subscript (dynamic)."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return '.'.join(reversed(parts))
+
+    def call_name(self, call):
+        """qual() of a Call's func."""
+        return self.qual(call.func) if isinstance(call, ast.Call) \
+            else None
+
+    def matches(self, q, canonical, tails):
+        """True when dotted name ``q`` is one of ``canonical`` or ends
+        in an accepted unqualified tail."""
+        if q is None:
+            return False
+        return q in canonical or q.rsplit('.', 1)[-1] in tails
+
+    # -- scopes ------------------------------------------------------------
+
+    def enclosing_scope(self, node):
+        """The innermost FunctionDef/Lambda/Module *containing* node."""
+        n = self.parents.get(node)
+        while n is not None and not isinstance(n, _SCOPE_NODES):
+            n = self.parents.get(n)
+        return n if n is not None else self.tree
+
+    def scope_chain(self, node):
+        """Enclosing scopes innermost-first, ending at the Module."""
+        out = []
+        s = self.enclosing_scope(node)
+        while True:
+            out.append(s)
+            if s is self.tree:
+                return out
+            s = self.enclosing_scope(s)
+
+    def enclosing_function(self, node):
+        """The innermost function containing node, or None at module
+        level."""
+        s = self.enclosing_scope(node)
+        return s if isinstance(s, _FUNC_NODES) else None
+
+    def in_loop(self, node, stop_at_function=False):
+        """True when node sits inside a for/while (or comprehension)
+        body.  ``stop_at_function=False`` keeps climbing through
+        function boundaries (a def inside a loop is still re-created
+        per iteration).  A comprehension's *first iterable* evaluates
+        once, so nodes inside it do not count as looped."""
+        n = self.parents.get(node)
+        while n is not None:
+            if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+                first_iter = n.generators[0].iter
+                if not any(s is node for s in ast.walk(first_iter)):
+                    return True
+            if stop_at_function and isinstance(n, _FUNC_NODES):
+                return False
+            n = self.parents.get(n)
+        return False
+
+    def memoized(self, fn):
+        """True when the function (or an enclosing one) is decorated
+        with functools.lru_cache / functools.cache — its body runs
+        once per config, so per-body jit construction is the *cached*
+        pattern, not a cache buster."""
+        while fn is not None:
+            for dec in getattr(fn, 'decorator_list', ()):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                q = self.qual(target) or ''
+                if q.rsplit('.', 1)[-1] in ('lru_cache', 'cache'):
+                    return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    # -- constants / axis tokens -------------------------------------------
+
+    def const_str(self, node):
+        """Resolve an expression to a string constant if possible:
+        literal, module constant, project-wide constant (e.g. the
+        runtime AXIS), else None."""
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str):
+            return node.value
+        name = self.qual(node)
+        if name is None:
+            return None
+        tail = name.rsplit('.', 1)[-1]
+        if tail in self.constants and \
+                isinstance(self.constants[tail], str):
+            return self.constants[tail]
+        if tail in self.project_constants:
+            return self.project_constants[tail]
+        return None
+
+    def axis_tokens(self, node):
+        """Axis-name tokens of an expression: ``('str', value)`` when
+        resolvable, ``('sym', name)`` for an unresolved identifier,
+        nothing for dynamic expressions.  Tuples/lists are flattened."""
+        out = set()
+        if node is None:
+            return out
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                out |= self.axis_tokens(e)
+            return out
+        s = self.const_str(node)
+        if s is not None:
+            out.add(('str', s))
+            return out
+        name = self.qual(node)
+        if name is not None:
+            out.add(('sym', name.rsplit('.', 1)[-1]))
+        return out
+
+    # -- traced marking ----------------------------------------------------
+
+    def _function_args(self, call):
+        """Function-valued arguments of a transform call: lambdas and
+        names resolving to defs visible from the call site."""
+        out = []
+        for arg in call.args:
+            if isinstance(arg, ast.Lambda):
+                out.append(arg)
+            elif isinstance(arg, (ast.Name, ast.Attribute)):
+                fn = self._resolve_def(arg, call)
+                if fn is not None:
+                    out.append(fn)
+            elif isinstance(arg, ast.Call):
+                # jit(shard_map(lambda ...)) / jit(partial(f, ...))
+                out.extend(self._function_args(arg))
+        return out
+
+    def _resolve_def(self, node, at):
+        """Find the def a Name refers to, searching the call site's
+        scope chain outward."""
+        if not isinstance(node, ast.Name):
+            return None
+        for scope in self.scope_chain(at):
+            fn = self.defs_by_scope.get(scope, {}).get(node.id)
+            if fn is not None:
+                return fn
+        return None
+
+    def _spec_axes(self, call):
+        """Axis tokens bound by a shard_map call's in/out specs."""
+        axes = set()
+        for kw in call.keywords:
+            if kw.arg in ('in_specs', 'out_specs', 'axis_names'):
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Call):
+                        q = self.qual(sub.func) or ''
+                        if q.rsplit('.', 1)[-1] in ('P',
+                                                    'PartitionSpec'):
+                            for a in sub.args:
+                                axes |= self.axis_tokens(a)
+                if kw.arg == 'axis_names':
+                    axes |= self.axis_tokens(kw.value)
+        return axes
+
+    def _mark_traced(self):
+        """Seed traced functions from transform call sites and
+        decorators, then propagate to nested defs and local callees."""
+        sm_axes = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                q = self.call_name(node)
+                if self.matches(q, TRANSFORMS, TRANSFORM_TAILS):
+                    fns = self._function_args(node)
+                    axes = set()
+                    if self.matches(q, SHARD_MAP_NAMES,
+                                    {'shard_map'}):
+                        axes = self._spec_axes(node)
+                    for fn in fns:
+                        self.traced.add(fn)
+                        if axes:
+                            sm_axes.setdefault(fn, set()).update(axes)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) \
+                        else dec
+                    q = self.qual(target)
+                    if isinstance(dec, ast.Call) and \
+                            self.matches(q, {'functools.partial'},
+                                         {'partial'}) and dec.args:
+                        q = self.qual(dec.args[0])
+                    if self.matches(q, TRANSFORMS, TRANSFORM_TAILS):
+                        self.traced.add(node)
+
+        self.shard_axes = sm_axes
+        # propagate: nested defs of traced functions are traced; local
+        # functions *called* from traced code are traced (same module);
+        # shard axes flow along the same edges
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                axes = self.shard_axes.get(fn, set())
+                for sub in ast.walk(fn):
+                    callee = None
+                    if isinstance(sub, _FUNC_NODES) and sub is not fn \
+                            and self.enclosing_function(sub) is fn:
+                        callee = sub
+                    elif isinstance(sub, ast.Call):
+                        callee = self._resolve_def(sub.func, sub)
+                    if callee is None:
+                        continue
+                    if callee not in self.traced:
+                        self.traced.add(callee)
+                        changed = True
+                    if axes and not axes <= \
+                            self.shard_axes.get(callee, set()):
+                        self.shard_axes.setdefault(
+                            callee, set()).update(axes)
+                        changed = True
+
+    def is_traced(self, node):
+        """True when ``node`` executes under a jax trace (it sits in a
+        traced function body)."""
+        fn = node if isinstance(node, _FUNC_NODES) \
+            else self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def axes_at(self, node):
+        """Union of shard_map axis tokens bound at ``node`` (empty =
+        not in a known shard_map body, or axes unresolvable)."""
+        axes = set()
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            axes |= self.shard_axes.get(fn, set())
+            fn = self.enclosing_function(fn)
+        return axes
+
+    # -- rank / parameter taint --------------------------------------------
+
+    def _is_rank_call(self, node):
+        if not isinstance(node, ast.Call):
+            return False
+        q = self.call_name(node) or ''
+        return q.rsplit('.', 1)[-1] in RANK_SOURCES
+
+    def rank_tainted_names(self, scope):
+        """Names in ``scope`` assigned (directly or one step derived)
+        from a process_index-like call."""
+        tainted = set()
+        body = scope.body if not isinstance(scope, ast.Lambda) else []
+        for _ in range(2):      # two passes: simple derived names
+            for stmt in ast.walk(ast.Module(body=list(body),
+                                            type_ignores=[])):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                         ast.AugAssign)):
+                    continue
+                value = stmt.value
+                if value is None:
+                    continue
+                hit = False
+                for sub in ast.walk(value):
+                    if self._is_rank_call(sub):
+                        hit = True
+                    elif isinstance(sub, ast.Name) and \
+                            sub.id in tainted and \
+                            isinstance(sub.ctx, ast.Load):
+                        hit = True
+                if not hit:
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        return tainted
+
+    def expr_rank_derived(self, node, tainted):
+        """True when the expression mentions a rank source or a
+        rank-tainted name."""
+        for sub in ast.walk(node):
+            if self._is_rank_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tainted and \
+                    isinstance(sub.ctx, ast.Load):
+                return True
+        return False
+
+    def param_tainted_names(self, fn):
+        """Names carrying (values derived from) the function's
+        parameters — the traced values inside a traced function."""
+        if isinstance(fn, ast.Lambda):
+            a = fn.args
+            tainted = {p.arg for p in
+                       a.posonlyargs + a.args + a.kwonlyargs}
+            for extra in (a.vararg, a.kwarg):
+                if extra is not None:
+                    tainted.add(extra.arg)
+            return tainted
+        a = fn.args
+        tainted = {p.arg for p in
+                   a.posonlyargs + a.args + a.kwonlyargs}
+        for extra in (a.vararg, a.kwarg):
+            if extra is not None:
+                tainted.add(extra.arg)
+        tainted.discard('self')
+        for _ in range(2):
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                         ast.AugAssign)):
+                    continue
+                value = stmt.value
+                if value is None:
+                    continue
+                hit = any(isinstance(s, ast.Name) and s.id in tainted
+                          and isinstance(s.ctx, ast.Load)
+                          for s in ast.walk(value))
+                if not hit:
+                    continue
+                targets = stmt.targets \
+                    if isinstance(stmt, ast.Assign) else [stmt.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        return tainted
+
+    # -- collectives -------------------------------------------------------
+
+    def is_collective(self, node):
+        if not isinstance(node, ast.Call):
+            return False
+        q = self.call_name(node)
+        return self.matches(q, frozenset(COLLECTIVES),
+                            COLLECTIVE_TAILS)
+
+    def collective_axis_arg(self, call):
+        """The axis_name argument expression of a collective call."""
+        for kw in call.keywords:
+            if kw.arg == 'axis_name':
+                return kw.value
+        q = self.call_name(call) or ''
+        tail = q.rsplit('.', 1)[-1]
+        pos = 0 if tail == 'axis_index' else 1
+        if len(call.args) > pos:
+            return call.args[pos]
+        return None
+
+    def functions_containing_collectives(self):
+        """Defs that (transitively, same module) execute a collective
+        when called — for the rank-gated-collective rule."""
+        if self._collective_funcs is not None:
+            return self._collective_funcs
+        direct = set()
+        for fn in self.functions:
+            for sub in ast.walk(fn):
+                if self.is_collective(sub) and \
+                        self.enclosing_function(sub) is fn:
+                    direct.add(fn)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if fn in direct:
+                    continue
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call):
+                        callee = self._resolve_def(sub.func, sub)
+                        if callee in direct:
+                            direct.add(fn)
+                            changed = True
+                            break
+        self._collective_funcs = direct
+        return direct

@@ -1,0 +1,217 @@
+"""File walking and the lint driver: parse, run rules, apply inline
+suppressions.
+
+Suppressions
+------------
+A finding is suppressed when its source line (or a standalone comment
+on the line directly above) carries::
+
+    x = compute()            # nbkl: disable=NBK301
+    # nbkl: disable=NBK201,NBK202
+    y = jit_in_loop()
+
+``disable=all`` silences every rule on that line.  A line anywhere in
+the file reading ``# nbkl: disable-file=NBK203`` (or ``=all``) silences
+the code(s) for the whole file — for modules whose domain legitimately
+violates a rule (document why next to the pragma).
+
+Path canonicalization: findings and baseline entries store paths
+relative to the repo layout (``nbodykit_tpu/...`` / ``tests/...``)
+regardless of the working directory the linter ran from, so a baseline
+written on one machine matches on another.
+"""
+
+import ast
+import os
+import re
+
+from .scopes import ModuleContext
+from .rules import Finding, run_rules
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*nbkl:\s*disable(?P<file>-file)?\s*=\s*'
+    r'(?P<codes>[A-Za-z0-9_,\s]+|all)')
+
+# package-wide constants the axis matcher may resolve names against
+# (collected from module-level string assignments on a first pass;
+# seeded with the runtime mesh axis so single-file runs still resolve)
+DEFAULT_PROJECT_CONSTANTS = {'AXIS': 'dev'}
+
+_TOPDIRS = ('nbodykit_tpu', 'tests', 'benchmarks', 'scripts')
+
+
+def canonical_path(path):
+    """Repo-relative spelling of ``path``: the suffix starting at the
+    last known top-level directory, else the basename."""
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] in _TOPDIRS:
+            return '/'.join(parts[i:])
+    return parts[-1] if parts else path
+
+
+def iter_target_files(paths):
+    """Yield .py files under the given files/directories, skipping
+    caches, hidden dirs and build residue; deterministic order."""
+    seen = set()
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith('.py') and p not in seen:
+                seen.add(p)
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if not d.startswith('.') and d != '__pycache__'
+                and d != 'build')
+            for fname in sorted(filenames):
+                if not fname.endswith('.py'):
+                    continue
+                full = os.path.join(dirpath, fname)
+                if full not in seen:
+                    seen.add(full)
+                    yield full
+
+
+def _line_suppressions(lines):
+    """(per-line code sets keyed by 1-based line, file-wide code set).
+    A standalone suppression comment also covers the next line."""
+    per_line, file_wide = {}, set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        codes = {c.strip().upper()
+                 for c in m.group('codes').split(',') if c.strip()}
+        if m.group('file'):
+            file_wide |= codes
+            continue
+        per_line.setdefault(i, set()).update(codes)
+        if text.lstrip().startswith('#'):       # standalone comment:
+            per_line.setdefault(i + 1, set()).update(codes)
+    return per_line, file_wide
+
+
+def _suppressed(finding, per_line, file_wide):
+    for codes in (file_wide, per_line.get(finding.line, ())):
+        if 'ALL' in codes or finding.code in codes:
+            return True
+    return False
+
+
+def lint_source(path, source, project_constants=None, select=None):
+    """Findings for one module's source text (suppressions applied).
+    A syntax error comes back as a single NBK000 finding rather than
+    an exception — the linter must be safe on broken code."""
+    try:
+        ctx = ModuleContext(path, source,
+                            project_constants=project_constants)
+    except SyntaxError as e:
+        return [Finding('NBK000', path, e.lineno or 1,
+                        (e.offset or 1) - 1,
+                        'syntax error: %s' % e.msg,
+                        'fix the parse error; no other rule ran on '
+                        'this file')]
+    findings = run_rules(ctx, select=select)
+    per_line, file_wide = _line_suppressions(ctx.lines)
+    return [f for f in findings
+            if not _suppressed(f, per_line, file_wide)]
+
+
+def collect_project_constants(files):
+    """First pass over all target files: module-level string constants
+    whose value is unambiguous project-wide (name -> value).  Lets the
+    axis matcher resolve ``from ..runtime import AXIS`` without
+    executing any imports."""
+    values = {}
+    for path in files:
+        try:
+            with open(path, encoding='utf-8') as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        from .scopes import collect_module_constants
+        for name, val in collect_module_constants(tree).items():
+            if isinstance(val, str):
+                values.setdefault(name, set()).add(val)
+    consts = dict(DEFAULT_PROJECT_CONSTANTS)
+    for name, vals in values.items():
+        if len(vals) == 1:
+            consts.setdefault(name, next(iter(vals)))
+    return consts
+
+
+def lint_paths(paths, select=None, project_constants=None):
+    """Lint every target file under ``paths``; returns findings with
+    canonical (repo-relative) paths, sorted."""
+    files = list(iter_target_files(paths))
+    consts = dict(project_constants or {})
+    if not consts:
+        consts = collect_project_constants(files)
+    findings = []
+    for path in files:
+        try:
+            with open(path, encoding='utf-8') as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding(
+                'NBK000', canonical_path(path), 1, 0,
+                'unreadable: %s' % e, 'fix the file permissions/path'))
+            continue
+        for f_ in lint_source(path, source, project_constants=consts,
+                              select=select):
+            findings.append(f_._replace(path=canonical_path(path)))
+    return sorted(findings,
+                  key=lambda f: (f.path, f.line, f.col, f.code))
+
+
+def default_targets(root=None):
+    """The package's own lint surface: ``nbodykit_tpu/`` plus the
+    multi-host worker (a collective program outside the package).
+    ``root`` defaults to the repo checkout guessed from this file;
+    falls back to the installed package directory."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    pkg = os.path.join(root, 'nbodykit_tpu')
+    if not os.path.isdir(pkg):
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = [pkg]
+    worker = os.path.join(root, 'tests', '_multihost_worker.py')
+    if os.path.isfile(worker):
+        targets.append(worker)
+    return targets
+
+
+def collect_jit_labels(paths):
+    """Map instrumented_jit labels to their call sites:
+    ``{label: (canonical_path, line)}`` — the doctor uses this to put
+    an NBK2xx finding next to the matching ``compile.<label>``
+    telemetry."""
+    labels = {}
+    for path in iter_target_files(paths):
+        try:
+            with open(path, encoding='utf-8') as f:
+                source = f.read()
+            ctx = ModuleContext(path, source)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = ctx.call_name(node) or ''
+            if q.rsplit('.', 1)[-1] != 'instrumented_jit':
+                continue
+            label = None
+            for kw in node.keywords:
+                if kw.arg == 'label' and \
+                        isinstance(kw.value, ast.Constant):
+                    label = kw.value.value
+            if label is None and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                label = node.args[0].id
+            if label:
+                labels[str(label)] = (canonical_path(path),
+                                      node.lineno)
+    return labels

@@ -197,6 +197,14 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     n = pos.shape[0]
     M = n0l * N1 * N2
     s = window_support(resampler)
+    # the flat deposit keys below are int32 (shapes are static, so this
+    # raises at trace time, not silently on device): the largest value
+    # formed is the dropped-slot sentinel M + (s-1)*(N1*N2+N2+1) + 1
+    if M + (s - 1) * (N1 * N2 + N2 + 1) + 1 > np.iinfo(np.int32).max:
+        raise ValueError(
+            "paint_local_sorted: local block %dx%dx%d (+window %d) "
+            "overflows the int32 flat index; shard the mesh over more "
+            "devices so n0_local*N1*N2 < 2**31" % (n0l, N1, N2, s))
     dtype = out.dtype if out is not None else (
         mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
     counter('paint.trace.sort').add(1)
@@ -214,6 +222,8 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     i2, w2 = _axis_terms(pos[:, 2], resampler, period[2])
     row0 = jnp.mod(i0[:, 0] - origin, period[0]).astype(jnp.int32)
     valid0 = row0 < n0l
+    # i32 is safe here: range proven < 2**31 by the trace-time guard
+    # above  # nbkl: disable=NBK302
     lin_base = ((jnp.where(valid0, row0, 0) * N1
                  + i1[:, 0].astype(jnp.int32)) * N2
                 + i2[:, 0].astype(jnp.int32))
@@ -251,6 +261,8 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
             for c in range(s):
                 d = (a * N1 + b) * N2 + c
                 w = w0s[:, a] * w1s[:, b] * w2s[:, c] * ms
+                # key + d bounded by the sentinel, < 2**31 by the
+                # trace-time guard  # nbkl: disable=NBK302
                 lin = ((jnp.where(valida, rowa, 0) * N1
                         + i1s[:, b].astype(jnp.int32)) * N2
                        + i2s[:, c].astype(jnp.int32))

@@ -140,7 +140,10 @@ def _bucket_local(dest, arrays, nproc, capacity, fill=0.0, live=None):
         rank_in_bucket = idx - start[dest_key]
         live_a = None if live is None else live[order]
         srcs = [a[order] for a in arrays]
-    # shared capacity/overflow accounting (branch-independent)
+    # shared capacity/overflow accounting (branch-independent).
+    # i32-audited (nbkl NBK302): slot < nproc*capacity + 1 <= the
+    # per-device buffer size, which must fit addressable memory —
+    # orders of magnitude inside int32 for any realizable exchange
     ok = rank_in_bucket < capacity
     lost = ~ok if live_a is None else (~ok & live_a)
     dropped = jnp.sum(lost)

@@ -25,10 +25,19 @@ python -m nbodykit_tpu.diagnostics --doctor --self-check-only
 echo "== doctor: bench regression gate =="
 python -m nbodykit_tpu.diagnostics --regress .
 
+# shard-safety lint gate: any finding not grandfathered in the
+# committed lint_baseline.json fails the smoke run (the module form
+# works without installing the nbodykit-tpu-lint console script)
+echo "== shard-safety lint gate =="
+python -m nbodykit_tpu.lint --baseline lint_baseline.json \
+    nbodykit_tpu/ tests/_multihost_worker.py
+
 echo "== tier-1 fast subset =="
 python -m pytest \
     tests/test_diagnostics.py \
     tests/test_diagnostics_analyze.py \
+    tests/test_lint.py \
+    tests/test_jax_compat.py \
     tests/test_pmesh.py \
     tests/test_fftpower.py \
     tests/test_counted_exchange.py \

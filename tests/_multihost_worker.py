@@ -8,6 +8,7 @@ pipeline on a deterministic particle set, and prints two replicated
 scalars every process must agree on.
 """
 
+import functools
 import os
 import re
 import sys
@@ -48,6 +49,18 @@ from nbodykit_tpu import diagnostics  # noqa: E402
 diagnostics.configure_from_env(default='/tmp/nbodykit-tpu-multihost-trace')
 
 
+# jitted barrier collective cached per mesh: re-wrapping the lambda
+# inside _barrier recompiled the psum on every barrier tag (an NBK202
+# finding of the shard-safety linter — the first bug it caught here)
+@functools.lru_cache(maxsize=8)
+def _allsum_for(mesh):
+    from jax.sharding import PartitionSpec as P
+    from nbodykit_tpu.parallel.runtime import AXIS
+    return jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(jnp.sum(v), AXIS), mesh=mesh,
+        in_specs=P(AXIS), out_specs=P()))
+
+
 def _barrier(mesh, tag):
     """An explicit cross-process sync point wrapped in a ``barrier``
     span: a replicated-scalar psum over the whole mesh is a collective
@@ -60,9 +73,7 @@ def _barrier(mesh, tag):
     x = jax.make_array_from_callback(
         (ndev,), NamedSharding(mesh, P(AXIS)),
         lambda idx: np.ones(ndev, 'f4')[idx])
-    allsum = jax.jit(jax.shard_map(
-        lambda v: jax.lax.psum(jnp.sum(v), AXIS), mesh=mesh,
-        in_specs=P(AXIS), out_specs=P()))
+    allsum = _allsum_for(mesh)
     with diagnostics.span('barrier', point=tag):
         total = float(allsum(x))
     assert total == ndev, (tag, total, ndev)

@@ -1,0 +1,508 @@
+"""The shard-safety linter: per-family positives/negatives, the
+suppression + baseline workflow, the package gate, and the acceptance
+fixture (a seeded rank-dependent collective must be caught by both the
+CLI and this pytest gate).
+
+These tests are pure-host (AST only) — no jax computation — so the
+whole module runs in well under a second apart from the package-wide
+gate sweep.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from nbodykit_tpu import lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_str(src, select=None):
+    return lint.lint_source(
+        'fixture.py', textwrap.dedent(src),
+        project_constants={'AXIS': 'dev'}, select=select)
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# NBK1xx — collectives
+
+SHARD_MAP_HEADER = """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+"""
+
+
+def test_nbk101_axis_mismatch_detected():
+    fs = lint_str(SHARD_MAP_HEADER + """
+    def body(x):
+        return jax.lax.psum(x, 'cols')
+
+    f = jax.shard_map(body, mesh=None, in_specs=P('rows'),
+                      out_specs=P('rows'))
+    """)
+    assert codes(fs) == ['NBK101']
+    assert "'cols'" in fs[0].message and "'rows'" in fs[0].message
+    assert fs[0].line == 7         # anchors on the psum call itself
+
+
+def test_nbk101_matching_axis_is_clean():
+    fs = lint_str(SHARD_MAP_HEADER + """
+    def body(x):
+        return jax.lax.psum(x, 'rows')
+
+    f = jax.shard_map(body, mesh=None, in_specs=P('rows'),
+                      out_specs=P('rows'))
+    """)
+    assert fs == []
+
+
+def test_nbk101_axis_constant_resolves_across_modules():
+    # AXIS resolves to 'dev' through the project constant table, so
+    # AXIS-vs-'dev' comparisons match instead of false-firing
+    fs = lint_str(SHARD_MAP_HEADER + """
+    from nbodykit_tpu.parallel.runtime import AXIS
+
+    def body(x):
+        return jax.lax.psum(x, AXIS)
+
+    f = jax.shard_map(body, mesh=None, in_specs=P('dev'),
+                      out_specs=P())
+    """)
+    assert fs == []
+
+
+def test_nbk101_unresolvable_axis_stays_silent():
+    # dynamic axis expressions can't be judged statically — no finding
+    fs = lint_str(SHARD_MAP_HEADER + """
+    def make(ax):
+        def body(x):
+            return jax.lax.psum(x, ax)
+        return jax.shard_map(body, mesh=None, in_specs=P('rows'),
+                             out_specs=P('rows'))
+    """, select=['NBK1'])
+    assert fs == []
+
+
+def test_nbk102_rank_gated_collective_detected():
+    fs = lint_str(SHARD_MAP_HEADER + """
+    def body(x):
+        if jax.process_index() == 0:
+            x = jax.lax.psum(x, 'dev')
+        return x
+    """, select=['NBK102'])
+    assert codes(fs) == ['NBK102']
+
+
+def test_nbk102_tainted_name_and_transitive_callee():
+    # rank flows through an assignment, and the collective sits in a
+    # helper the branch calls — both hops must be followed
+    fs = lint_str(SHARD_MAP_HEADER + """
+    def reduce_all(x):
+        return jax.lax.psum(x, 'dev')
+
+    def body(x):
+        rank = jax.process_index()
+        if rank == 0:
+            x = reduce_all(x)
+        return x
+    """, select=['NBK102'])
+    assert codes(fs) == ['NBK102']
+
+
+def test_nbk102_data_dependent_branch_is_clean():
+    fs = lint_str(SHARD_MAP_HEADER + """
+    def body(x, flag):
+        if flag:
+            x = jax.lax.psum(x, 'dev')
+        return x
+    """, select=['NBK102'])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# NBK2xx — compile hygiene
+
+def test_nbk201_jit_in_loop():
+    fs = lint_str("""
+    import jax
+
+    def run(xs):
+        out = []
+        for x in xs:
+            out.append(jax.jit(step)(x))
+        return out
+
+    def step(x):
+        return x
+    """, select=['NBK201'])
+    assert codes(fs) == ['NBK201']
+
+
+def test_nbk201_module_level_jit_clean():
+    fs = lint_str("""
+    import jax
+
+    def step(x):
+        return x
+
+    fast_step = jax.jit(step)
+    """, select=['NBK2'])
+    assert fs == []
+
+
+def test_nbk202_lambda_per_call():
+    fs = lint_str("""
+    import jax
+
+    def run(x):
+        f = jax.jit(lambda v: v * 2)
+        return f(x)
+    """, select=['NBK202'])
+    assert codes(fs) == ['NBK202']
+
+
+def test_nbk202_lru_cached_builder_is_the_fix():
+    # the dfft.py pattern: a memoized builder constructs jits once per
+    # config — that's the recommended fix, not a finding
+    fs = lint_str("""
+    import functools
+    import jax
+
+    @functools.lru_cache(maxsize=8)
+    def programs(shape):
+        return jax.jit(lambda v: v.reshape(shape))
+    """, select=['NBK2'])
+    assert fs == []
+
+
+def test_nbk203_unhashable_static_args():
+    fs = lint_str("""
+    import jax
+
+    def f(x, shape):
+        return x.reshape(shape)
+
+    fj = jax.jit(f, static_argnums=(1,))
+    y = fj(data, [4, 4])
+    """, select=['NBK203'])
+    assert codes(fs) == ['NBK203']
+
+
+def test_nbk203_tuple_static_arg_clean():
+    fs = lint_str("""
+    import jax
+
+    def f(x, shape):
+        return x.reshape(shape)
+
+    fj = jax.jit(f, static_argnums=(1,))
+    y = fj(data, (4, 4))
+    """, select=['NBK203'])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# NBK3xx — precision
+
+def test_nbk301_float64_in_traced_code():
+    fs = lint_str("""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x + jnp.zeros(3, dtype=jnp.float64)
+    """, select=['NBK301'])
+    assert codes(fs) == ['NBK301']
+
+
+def test_nbk301_host_numpy_f8_is_clean():
+    # host-side numpy prep (gridhash.py style) legitimately uses f8
+    fs = lint_str("""
+    import numpy as np
+
+    def prep(pos):
+        return np.asarray(pos, dtype='f8')
+    """, select=['NBK301'])
+    assert fs == []
+
+
+def test_nbk301_x64_guard_and_working_dtype_exempt():
+    fs = lint_str("""
+    import jax
+    import jax.numpy as jnp
+    from nbodykit_tpu.utils import working_dtype
+
+    def f():
+        a = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        b = jnp.zeros(3, dtype=a)
+        c = jnp.zeros(3, dtype=working_dtype('f8'))
+        return b, c
+    """, select=['NBK301'])
+    assert fs == []
+
+
+def test_nbk302_int32_flat_index_chain():
+    fs = lint_str("""
+    import jax.numpy as jnp
+
+    def flatten(ci, n1, n2):
+        return (ci[:, 0].astype(jnp.int32) * n1 + ci[:, 1]) * n2 \\
+            + ci[:, 2]
+    """, select=['NBK302'])
+    assert codes(fs) == ['NBK302']
+
+
+def test_nbk302_single_multiply_clean():
+    fs = lint_str("""
+    import jax.numpy as jnp
+
+    def pair(src, dest, nproc):
+        return src.astype(jnp.int32) * nproc + dest
+    """, select=['NBK302'])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# NBK4xx — trace safety
+
+def test_nbk401_host_sync_in_traced_code():
+    fs = lint_str("""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        v = float(x)
+        a = np.asarray(x)
+        b = x.sum().item()
+        return v, a, b
+    """, select=['NBK401'])
+    assert codes(fs) == ['NBK401'] * 3
+
+
+def test_nbk401_shape_math_and_host_code_clean():
+    fs = lint_str("""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        n = int(x.shape[0])
+        return x * n
+
+    def host(y):
+        return float(y)
+    """, select=['NBK401'])
+    assert fs == []
+
+
+def test_nbk402_impure_host_op_in_trace():
+    fs = lint_str("""
+    import time
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x + np.random.uniform() + time.time()
+    """, select=['NBK402'])
+    assert codes(fs) == ['NBK402'] * 2
+
+
+def test_nbk402_host_randomness_clean():
+    fs = lint_str("""
+    import time
+    import numpy as np
+
+    def seed():
+        return np.random.randint(0, 2 ** 31 - 1), time.time()
+    """, select=['NBK402'])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline workflow
+
+def test_inline_and_file_suppressions():
+    src = """
+    import jax
+
+    def run(x):
+        f = jax.jit(lambda v: v)  # nbkl: disable=NBK202
+        # nbkl: disable=NBK202
+        g = jax.jit(lambda v: v + 1)
+        h = jax.jit(lambda v: v + 2)
+        return f(x), g(x), h(x)
+    """
+    fs = lint_str(src, select=['NBK202'])
+    assert len(fs) == 1 and fs[0].line == 8     # only h() fires
+
+    fs = lint_str('# nbkl: disable-file=NBK202\n'
+                  + textwrap.dedent(src), select=['NBK202'])
+    assert fs == []
+
+
+def test_baseline_roundtrip_survives_line_drift(tmp_path):
+    src_v1 = textwrap.dedent("""
+    import jax
+
+    def run(x):
+        return jax.jit(lambda v: v)(x)
+    """)
+    findings = lint.lint_source('pkg.py', src_v1, select=['NBK202'])
+    assert len(findings) == 1
+    sources = {'pkg.py': src_v1.splitlines()}
+    doc = lint.build_baseline(findings, sources=sources)
+    path = str(tmp_path / 'baseline.json')
+    lint.write_baseline(doc, path)
+
+    # same finding, shifted two lines down: still grandfathered
+    src_v2 = '# new header\n# more header\n' + src_v1
+    moved = lint.lint_source('pkg.py', src_v2, select=['NBK202'])
+    assert moved[0].line == findings[0].line + 2
+    new, grand, unused = lint.apply_baseline(
+        moved, lint.load_baseline(path),
+        sources={'pkg.py': src_v2.splitlines()})
+    assert new == [] and len(grand) == 1 and unused == []
+
+    # finding fixed: the stale baseline entry is reported for pruning
+    new, grand, unused = lint.apply_baseline(
+        [], lint.load_baseline(path), sources={})
+    assert new == [] and grand == [] and len(unused) == 1
+
+
+def test_malformed_baseline_raises(tmp_path):
+    path = str(tmp_path / 'baseline.json')
+    with open(path, 'w') as f:
+        f.write('{"not": "a baseline"}')
+    try:
+        lint.load_baseline(path)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError('malformed baseline must not load')
+
+
+# ---------------------------------------------------------------------------
+# the package gate: the committed baseline covers everything
+
+def test_package_has_no_unbaselined_findings():
+    new, grandfathered, unused = lint.run_lint(
+        lint.default_targets(REPO),
+        baseline_path=os.path.join(REPO, 'lint_baseline.json'))
+    assert new == [], (
+        'non-baselined lint findings — fix them or (if audited) add '
+        'them to lint_baseline.json:\n'
+        + lint.render_findings(new))
+    assert unused == [], (
+        'stale lint_baseline.json entries (the findings were fixed); '
+        'prune them: %r' % unused)
+    # the baseline exists and every grandfathered entry carries weight
+    assert len(grandfathered) > 0
+
+
+def test_jit_label_map_covers_instrumented_hot_paths():
+    labels = lint.collect_jit_labels(lint.default_targets(REPO))
+    assert 'fftpower.binning' in labels
+    path, line = labels['fftpower.binning']
+    assert path == 'nbodykit_tpu/algorithms/fftpower.py' and line > 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a seeded rank-dependent collective is caught by the CLI
+# and by the same API path this pytest gate uses
+
+RANK_GATED_FIXTURE = textwrap.dedent("""
+    import jax
+    import jax.numpy as jnp
+
+    def broken(x):
+        if jax.process_index() == 0:
+            x = jax.lax.psum(x, 'dev')
+        return x
+""")
+
+
+def test_seeded_hazard_detected_by_pytest_gate(tmp_path):
+    pkg = tmp_path / 'nbodykit_tpu'
+    pkg.mkdir()
+    (pkg / 'seeded.py').write_text(RANK_GATED_FIXTURE)
+    new, _, _ = lint.run_lint([str(pkg)])
+    assert [f.code for f in new] == ['NBK102']
+    assert new[0].path == 'nbodykit_tpu/seeded.py'
+
+
+def test_seeded_hazard_detected_by_cli(tmp_path):
+    fixture = tmp_path / 'seeded.py'
+    fixture.write_text(RANK_GATED_FIXTURE)
+    proc = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', str(fixture)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert 'NBK102' in proc.stdout
+    # with the hazard grandfathered the same invocation gates green
+    bl = tmp_path / 'baseline.json'
+    subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', str(fixture),
+         '--write-baseline', str(bl)],
+        capture_output=True, text=True, cwd=REPO, check=True)
+    proc = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', str(fixture),
+         '--baseline', str(bl)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_and_rule_catalog(tmp_path):
+    fixture = tmp_path / 'seeded.py'
+    fixture.write_text(RANK_GATED_FIXTURE)
+    proc = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', str(fixture),
+         '--json'],
+        capture_output=True, text=True, cwd=REPO)
+    data = json.loads(proc.stdout)
+    assert data['summary']['by_code'] == {'NBK102': 1}
+    proc = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', '--list-rules'],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    for code in ('NBK101', 'NBK102', 'NBK201', 'NBK202', 'NBK203',
+                 'NBK301', 'NBK302', 'NBK401', 'NBK402'):
+        assert code in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# doctor cross-link: compile misses + open NBK2xx finding on one line
+
+def test_doctor_cross_links_compile_misses_to_nbk2(tmp_path, capsys):
+    import shutil
+
+    from nbodykit_tpu.diagnostics import REGISTRY, counter
+    from nbodykit_tpu.diagnostics.__main__ import run_doctor
+
+    # a throwaway root mirroring the repo's lint surface, so the
+    # doctor's regress step writes its BENCH_HISTORY there, not here
+    root = str(tmp_path)
+    os.symlink(os.path.join(REPO, 'nbodykit_tpu'),
+               os.path.join(root, 'nbodykit_tpu'))
+    shutil.copy(os.path.join(REPO, 'lint_baseline.json'),
+                os.path.join(root, 'lint_baseline.json'))
+    counter('compile.fftpower.binning.misses').add(3)
+    try:
+        rc = run_doctor(trace=None, root=root)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert 'lint         OK' in out
+        assert 'compile      WARN' in out
+        assert "'fftpower.binning'" in out
+        assert 'NBK202' in out and 'fftpower.py' in out
+    finally:
+        REGISTRY.reset()
