@@ -83,6 +83,11 @@ STAGED_PATH = os.environ.get('BENCH_STAGED_PATH',
 # disable
 TRACE_DIR = os.environ.get('BENCH_TRACE_DIR',
                            os.path.join(HERE, 'BENCH_TRACE'))
+# per-rep checkpoints (nbodykit_tpu.resilience, docs/RESILIENCE.md):
+# a SIGKILLed / tunnel-killed run resumes its timed reps on relaunch
+# instead of restarting, and the record carries resumed: true
+CKPT_DIR = os.environ.get('BENCH_CKPT_DIR',
+                          os.path.join(HERE, 'BENCH_CKPT'))
 
 TPU_PLATFORMS = ('tpu', 'axon')
 
@@ -277,11 +282,71 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
     return fftpower, phases
 
 
-def _time_fn(jax, fn, args, reps, label='fn', on_warm=None):
+def _timed_reps(once, reps, label, ckpt=None, key=None, rec=None,
+                ladder=None):
+    """The timed measurement queue, run under the resilience stack
+    (nbodykit_tpu.resilience, docs/RESILIENCE.md):
+
+    - each rep runs under a :class:`Supervisor` — injected or real
+      ``UNAVAILABLE``/deadline faults get bounded-backoff retries, and
+      ``RESOURCE_EXHAUSTED`` steps down the FFT/paint memory ladder
+      when one is passed (only paths that re-read the options per call
+      — the eager lowmem FFT drivers, convpower's eager compose — can
+      profit; a compiled fused program cannot, its OOM falls through
+      to run_config's structural staged fallback);
+    - each completed rep commits an atomic checkpoint, so a run killed
+      mid-timing resumes at the next rep on relaunch and the final
+      record carries ``resumed: true`` (round 5 lost the 1024³ record
+      exactly there);
+    - ``bench.rep`` is a named fault point: ``NBKIT_FAULTS=
+      'bench.rep@2:kill'`` rehearses the mid-rep death on CPU.
+
+    ``once`` must run AND sync one rep.  Returns the mean rep wall.
+    """
+    from nbodykit_tpu.diagnostics import span
+    from nbodykit_tpu.resilience import Supervisor, fault_point
+    sup = Supervisor('bench.%s' % label, ladder=ladder, checkpoint=ckpt)
+    done, elapsed = 0, 0.0
+    if ckpt is not None and key is not None:
+        got = sup.resume(key, validate=lambda s: (
+            s.get('reps') == reps and s.get('label') == label
+            and 0 < s.get('completed', 0) <= reps))
+        if got is not None:
+            done = int(got[0]['completed'])
+            elapsed = float(got[0].get('elapsed_s', 0.0))
+            if rec is not None:
+                rec['resumed'] = True
+                rec['resumed_reps'] = done
+    for r in range(done, reps):
+        fault_point('bench.rep')
+        t0 = time.time()
+        with span('bench.rep', label=label, rep=r):
+            sup.run(once)
+        elapsed += time.time() - t0
+        if key is not None:
+            sup.save(key, {'label': label, 'reps': reps,
+                           'completed': r + 1,
+                           'elapsed_s': round(elapsed, 6)})
+    if rec is not None and sup.events:
+        retr = [e for e in sup.events if e['kind'] == 'retries']
+        degr = [e for e in sup.events if e['kind'] == 'degradations']
+        if retr:
+            rec['retries'] = len(retr)
+        if degr:
+            rec['degradations'] = [
+                dict(e.get('detail', {}), rung=e.get('rung'))
+                for e in degr]
+    return elapsed / reps
+
+
+def _time_fn(jax, fn, args, reps, label='fn', on_warm=None, ckpt=None,
+             key=None, rec=None):
     """Warm (compile) + timed reps.  ``on_warm(compile_s)`` fires after
     the warm-up sync and BEFORE the timed loop — the hook run_config
     uses to stage a partial record ahead of the final timing barrier
-    (a tunnel death mid-reps then still leaves a number on disk)."""
+    (a tunnel death mid-reps then still leaves a number on disk).
+    The reps themselves run checkpointed + supervised
+    (:func:`_timed_reps`)."""
     from nbodykit_tpu.diagnostics import span
     with span('bench.warmup', label=label):
         out = fn(*args)
@@ -290,12 +355,9 @@ def _time_fn(jax, fn, args, reps, label='fn', on_warm=None):
         compile_s = time.time() - t0  # first-call includes compile
     if on_warm is not None:
         on_warm(compile_s)
-    t0 = time.time()
-    for r in range(reps):
-        with span('bench.rep', label=label, rep=r):
-            out = fn(*args)
-            _sync(jax, out)
-    return (time.time() - t0) / reps, compile_s
+    dt = _timed_reps(lambda: _sync(jax, fn(*args)), reps, label,
+                     ckpt=ckpt, key=key, rec=rec)
+    return dt, compile_s
 
 
 def _baseline_for(metric):
@@ -379,6 +441,12 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         **({"paint_method_overridden": "sort->scatter (HBM)"}
            if overridden else {}),
     }
+    # per-rep checkpoints keyed by metric (the TPU + forced-CPU worker
+    # pair never collide); a relaunch after a mid-rep death resumes
+    # here instead of restarting the rung
+    from nbodykit_tpu.resilience import CheckpointStore, default_ladder
+    ckpt = CheckpointStore(CKPT_DIR)
+    ckey = 'bench.' + rec['metric']
     # the axon remote-compile helper dies on the fused program at
     # Nmesh>=512 (HTTP 500 / subprocess exit 1, and the dead helper
     # then hangs every later compile RPC for ~27 min before
@@ -396,7 +464,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
                 label='fused',
                 on_warm=lambda cs: _stage_partial(
                     rec, partial=True, stage='warmed', mode='fused',
-                    first_run_s=round(cs, 4)))
+                    first_run_s=round(cs, 4)),
+                ckpt=ckpt, key=ckey, rec=rec)
             rec['mode'] = 'fused'
         except Exception as e:
             if not any(s in str(e) for s in
@@ -460,14 +529,16 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         # reps — a tunnel death mid-timing no longer loses the rung
         _stage_partial(rec, partial=True, stage='warmed', mode='staged',
                        first_run_s=round(compile_s, 4))
-        t0 = time.time()
-        for r in range(reps):
-            with _span('bench.rep', label='staged', rep=r):
-                _sync(jax, run_once())
-        dt = (time.time() - t0) / reps
+        # the staged/eager paths re-read the options per call, so the
+        # supervisor's OOM ladder (fft_chunk_bytes / paint_chunk_size
+        # halving) actually changes the re-run program
+        dt = _timed_reps(lambda: _sync(jax, run_once()), reps,
+                         'staged', ckpt=ckpt, key=ckey, rec=rec,
+                         ladder=default_ladder())
     rec.update(value=round(dt, 4), compile_s=round(compile_s, 1))
     _stamp(rec)
     _stage_partial(rec, partial=False, stage='complete')
+    ckpt.delete(ckey)   # the rung is on disk complete; nothing to resume
     _attach_baseline(rec)
 
     if method == 'mxu':
@@ -591,13 +662,21 @@ def run_fkp(Nmesh=512, nbar=1e-4, reps=1):
             float(np.asarray(cp.poles['power_0'].real)[0])
             return cp
 
+    # supervised: round 5's FKP hardware proof died RESOURCE_EXHAUSTED
+    # with no response — now an OOM steps down the FFT/paint memory
+    # ladder and re-runs (ConvolvedFFTPower composes eagerly, so the
+    # degraded options take effect on the very next attempt), and
+    # UNAVAILABLE gets bounded-backoff retries
+    from nbodykit_tpu.resilience import Supervisor, default_ladder
+    sup = Supervisor('bench.fkp', ladder=default_ladder())
+
     # warm (compiles included in first run)
     t0 = time.time()
-    cp = once()
+    cp = sup.run(once)
     compile_s = time.time() - t0
     t0 = time.time()
     for _ in range(reps):
-        cp = once()
+        cp = sup.run(once)
     dt = (time.time() - t0) / reps
 
     p0 = np.asarray(cp.poles['power_0'].real)
@@ -611,6 +690,15 @@ def run_fkp(Nmesh=512, nbar=1e-4, reps=1):
         "p0_first5": [float(x) for x in p0[:5]],
         "shotnoise": float(cp.attrs.get('shotnoise', float('nan'))),
     }
+    if sup.events:
+        degr = [e for e in sup.events if e['kind'] == 'degradations']
+        retr = [e for e in sup.events if e['kind'] == 'retries']
+        if degr:
+            rec['degradations'] = [
+                dict(e.get('detail', {}), rung=e.get('rung'))
+                for e in degr]
+        if retr:
+            rec['retries'] = len(retr)
     base = _baseline_for(rec['metric'])
     if base is not None:
         # same-seed catalogs -> the CPU record's P0 must agree
@@ -1276,8 +1364,13 @@ if __name__ == '__main__':
     if argv[0] == '--worker':
         sys.exit(cmd_worker())
     if argv[0] == '--config':
-        print(json.dumps(run_config(int(argv[1]), int(argv[2]),
-                                    *(argv[3:4] or ['scatter']))))
+        # BENCH_REPS / BENCH_PHASES: the fault-injected resume smoke
+        # (scripts/smoke.sh, tests/test_resilience.py) runs a tiny
+        # 2-rep config with the phase split off
+        print(json.dumps(run_config(
+            int(argv[1]), int(argv[2]), *(argv[3:4] or ['scatter']),
+            reps=int(os.environ.get('BENCH_REPS', '2') or 2),
+            phases=os.environ.get('BENCH_PHASES', '1') != '0')))
         sys.exit(0)
     if argv[0] == '--fftbw':
         print(json.dumps(run_fftbw(int(argv[1]) if argv[1:] else 512)))

@@ -66,6 +66,13 @@ _default_options = {
     # workers (bench, multi-host) can be told to leave a post-mortem
     # trace without code changes.
     'diagnostics': os.environ.get('NBKIT_DIAGNOSTICS') or None,
+    # deterministic fault injection (nbodykit_tpu.resilience.faults,
+    # docs/RESILIENCE.md): 'point@N:action[,...]' fires a chosen
+    # XlaRuntimeError (or SIGKILL) at the Nth call to a named fault
+    # point. None disables. Seeded from $NBKIT_FAULTS so detached
+    # workers (bench, multi-host) can be fault-injected without code
+    # changes.
+    'faults': os.environ.get('NBKIT_FAULTS') or None,
 }
 
 
@@ -152,6 +159,12 @@ class set_options(object):
         file): enables the span tracer + metrics of
         :mod:`nbodykit_tpu.diagnostics` with crash-safe JSONL output.
         None (the default) disables all tracing at zero cost.
+    faults : str or None
+        deterministic fault-injection spec
+        (``'point@N:action[,...]'``) for
+        :mod:`nbodykit_tpu.resilience.faults`; actions are
+        ``unavailable`` / ``resource_exhausted`` / ``deadline`` /
+        ``internal`` / ``kill``.  None (the default) disables.
     """
 
     def __init__(self, **kwargs):

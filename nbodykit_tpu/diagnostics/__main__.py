@@ -213,6 +213,35 @@ def _compile_miss_labels(trace):
     return labels
 
 
+def _resilience_counts(trace):
+    """Observed retry/degrade/resume/fault totals: live registry
+    counters merged (per-key max, so a same-process doctor run does
+    not double-count its own trace) with ``resilience.*`` event spans
+    found in the analyzed trace directory."""
+    from .metrics import prefixed
+    counts = {k: int(m.get('value', 0))
+              for k, m in prefixed('resilience.').items()
+              if m.get('type') == 'counter'}
+    span_keys = {'resilience.retry': 'retries',
+                 'resilience.degrade': 'degradations',
+                 'resilience.resume': 'resumes'}
+    if trace and os.path.exists(trace):
+        try:
+            from .analyze import load_processes
+            procs, _ = load_processes(trace)
+        except Exception:
+            procs = {}
+        traced = {}
+        for records in procs.values():
+            for r in records:
+                key = span_keys.get(r.get('name', ''))
+                if r.get('t') == 'span' and key:
+                    traced[key] = traced.get(key, 0) + 1
+        for key, n in traced.items():
+            counts[key] = max(counts.get(key, 0), n)
+    return counts
+
+
 def run_doctor(trace=None, root='.', self_check_only=False,
                out=None, threshold=0.25, stale_hours=24.0):
     """Self-check + analyze + regress + lint, one verdict block.
@@ -346,6 +375,37 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                          'cache %dx — open %s at %s:%d: %s'
                          % (label, nmiss, f0.code, f0.path, f0.line,
                             f0.message))
+
+    if root is not None or trace:
+        # resilience posture: what the supervisor did (retries /
+        # degradations / resumes, from counters + the merged trace)
+        # and whether an interrupted measurement is still awaiting
+        # relaunch (pending checkpoints under BENCH_CKPT)
+        from .regress import resilience_summary
+        counts = _resilience_counts(trace)
+        res = resilience_summary(root) if root is not None else {}
+        activity = ('retries=%d degradations=%d resumes=%d '
+                    'faults_injected=%d'
+                    % (counts.get('retries', 0),
+                       counts.get('degradations', 0),
+                       counts.get('resumes', 0),
+                       counts.get('faults.injected', 0)))
+        pending = res.get('pending_checkpoints', 0)
+        if pending:
+            warn.append('resilience')
+            lines.append('resilience   WARN: %s; %d pending '
+                         'checkpoint(s) under BENCH_CKPT (oldest '
+                         '%s h) — an interrupted run has not been '
+                         'resumed, relaunch the bench to finish it'
+                         % (activity, pending,
+                            res.get('oldest_checkpoint_hours', '?')))
+        else:
+            extra = ''
+            if res.get('resumed_records'):
+                extra = ('; %d committed record(s) came from resumed '
+                         'runs' % res['resumed_records'])
+            lines.append('resilience   OK: %s; no pending '
+                         'checkpoints%s' % (activity, extra))
 
     verdict = 'FAIL (%s)' % ', '.join(fail) if fail else \
         ('WARN (%s)' % ', '.join(warn) if warn else 'OK')

@@ -47,6 +47,10 @@ _PHASE_PREFIXES = (
     ('exchange', 'exchange'),
     ('paint', 'paint'),
     ('readout', 'paint'),
+    # retry backoffs / degrade / resume marks (nbodykit_tpu.resilience):
+    # supervisor dead time is attributed, not hidden in 'other'
+    ('resilience.', 'resilience'),
+    ('ckpt.', 'resilience'),
 )
 
 

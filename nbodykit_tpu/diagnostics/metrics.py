@@ -170,6 +170,17 @@ gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram
 
 
+def prefixed(prefix, registry=None):
+    """Snapshot of every metric whose name starts with ``prefix``
+    (e.g. ``prefixed('resilience.')`` for the doctor's retry/
+    degradation/resume totals), keyed by the name with the prefix
+    stripped."""
+    reg = registry if registry is not None else REGISTRY
+    snap = reg if isinstance(reg, dict) else reg.snapshot()
+    return {name[len(prefix):]: m for name, m in snap.items()
+            if name.startswith(prefix)}
+
+
 # ---------------------------------------------------------------------------
 # compile telemetry
 

@@ -262,6 +262,39 @@ def lint_summary(root):
         return {'error': str(e)}
 
 
+def resilience_summary(root, now=None):
+    """Resilience posture for the round record: how many committed
+    records were produced by a resumed run, and whether checkpoints
+    are pending under ``root``/BENCH_CKPT (a pending checkpoint is an
+    interrupted measurement nobody has relaunched — exactly the
+    round-5 evidence loss, now visible).  Never raises."""
+    now = time.time() if now is None else now
+    out = {'resumed_records': 0, 'pending_checkpoints': 0,
+           'oldest_checkpoint_hours': None}
+    for fname in ('BENCH_STAGED.json',) + CACHE_FILES:
+        try:
+            with open(os.path.join(root, fname)) as f:
+                recs = json.load(f).get('results', {})
+        except (OSError, ValueError):
+            continue
+        out['resumed_records'] += sum(
+            1 for rec in recs.values()
+            if isinstance(rec, dict) and rec.get('resumed'))
+    ckpt_dir = os.path.join(root, 'BENCH_CKPT')
+    if os.path.isdir(ckpt_dir):
+        try:
+            from ..resilience import CheckpointStore
+            store = CheckpointStore(ckpt_dir)
+            keys = store.keys()
+            out['pending_checkpoints'] = len(keys)
+            age = store.oldest_age_s(now=now)
+            if age is not None:
+                out['oldest_checkpoint_hours'] = round(age / 3600.0, 1)
+        except Exception as e:     # pragma: no cover - defensive
+            out['error'] = str(e)
+    return out
+
+
 def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
                   now=None, write=True):
     """Assemble + (atomically) write ``BENCH_HISTORY.json``; returns
@@ -277,6 +310,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'stale_hours': stale_hours,
         'rounds': entries,
         'lint': lint_summary(root),
+        'resilience': resilience_summary(root, now=now),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
                            if e.get('verdict') == v)
@@ -322,6 +356,19 @@ def render_regress(history):
              ', %d older than the stale bar (fine for a cache; loud '
              'only when replayed as a headline)' % len(stale)
              if stale else ''))
+    res = history.get('resilience')
+    if res is not None:
+        bits = []
+        if res.get('resumed_records'):
+            bits.append('%d committed record(s) from resumed runs'
+                        % res['resumed_records'])
+        if res.get('pending_checkpoints'):
+            bits.append('%d PENDING checkpoint(s) (oldest %s h) — an '
+                        'interrupted measurement awaits relaunch'
+                        % (res['pending_checkpoints'],
+                           res.get('oldest_checkpoint_hours', '?')))
+        if bits:
+            w('  resilience: %s' % '; '.join(bits))
     lint = history.get('lint')
     if lint is not None:
         if 'error' in lint:

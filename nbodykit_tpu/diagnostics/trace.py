@@ -282,6 +282,13 @@ class Tracer(object):
     def span(self, name, attrs=None):
         return _Span(self, name, attrs)
 
+    def event(self, name, attrs=None, ok=True):
+        """Record an instantaneous event as a zero-duration span at
+        *now* — the form the resilience supervisor uses for retry /
+        degrade / resume marks, so they land in the merged timeline
+        (and straggler/critical-path tables) like any other span."""
+        self.emit_span(name, time.time(), 0.0, attrs=attrs, ok=ok)
+
     def emit_span(self, name, ts, dur, attrs=None, ok=True):
         """Record a completed span observed out-of-band — e.g. a compile
         reported after the fact by ``jax.monitoring`` (metrics.py), where

@@ -102,6 +102,12 @@ def paint_local(pos, mass, shape, resampler='cic', period=None, origin=0,
 
     counter('paint.trace.scatter').add(1)
     counter('paint.trace.scatter_particles').add(int(n))
+    # which batch size this program was COMPILED with: the resilience
+    # ladder (docs/RESILIENCE.md) degrades paint_chunk_size on OOM, and
+    # this gauge is how a post-mortem confirms the smaller batch
+    # actually reached the next trace
+    gauge('paint.trace.chunk_particles').set(
+        int(min(chunk, n)) if chunk else int(n))
     mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
 
     def body(pos_c, mass_c, flat):

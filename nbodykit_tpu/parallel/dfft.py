@@ -90,8 +90,14 @@ def rfftn_single_lowmem(x_box, norm=None, target=None):
     ``x_box`` is a single-element list holding the real field; the
     list is emptied (ownership transfer) so the input buffer can be
     freed as soon as the first pass is done — the caller must not keep
-    another reference.  Returns the transposed (N1, N0, Nc) layout of
-    :func:`dist_rfftn`.  Not traceable: call outside jit.
+    another reference.  The ~2-buffer peak therefore only holds when
+    the WHOLE call chain relinquishes: reached via :func:`dist_rfftn`
+    the public caller retains its own reference to the field, and the
+    peak is ~3 full-mesh buffers (input + intermediate + output) —
+    callers that need the tight contract (bench.py's staged 1024³
+    path) build the box in-place and call this driver directly.
+    Returns the transposed (N1, N0, Nc) layout of :func:`dist_rfftn`.
+    Not traceable: call outside jit.
     """
     if isinstance(x_box, (list,)):
         x = x_box.pop()
@@ -243,6 +249,115 @@ def _lowmem_programs(shape, dtype_str, norm, target):
                              donate_argnums=(0,)))
 
 
+@_lru_cache(maxsize=16)
+def _lowmem_c2c_programs(shape, dtype_str, inverse, norm, target):
+    """Jitted stage programs for :func:`fftn_c2c_single_lowmem` (same
+    caching/donation rationale as :func:`_lowmem_programs`)."""
+    dt = jnp.dtype(dtype_str)
+    cdt = jnp.result_type(dt, jnp.complex64)
+    csz = jnp.dtype(cdt).itemsize
+    op_target = max(target // 4, 1)
+    if inverse:
+        N1, N0, N2 = shape
+    else:
+        N0, N1, N2 = shape
+    r0 = _chunk_rows(N0, N1 * N2 * csz, op_target)
+    r1 = _chunk_rows(N1, N0 * N2 * csz, op_target)
+    fft = jnp.fft.ifft if inverse else jnp.fft.fft
+
+    def _upd_row(dst, s, i):
+        z = jnp.zeros((), i.dtype)
+        return jax.lax.dynamic_update_slice(dst, s, (i, z, z))
+
+    def _upd_col(dst, s, j):
+        z = jnp.zeros((), j.dtype)
+        return jax.lax.dynamic_update_slice(dst, s, (z, j, z))
+
+    if not inverse:
+        # pass A: fft z + fft y over x-slabs (in place); pass B: fft x
+        # over y-slabs of the intermediate, written transposed
+        @instrumented_jit(label='fft.lowmem.c2c.slab_a')
+        def slab_a(x, i):
+            z = jnp.zeros((), i.dtype)
+            sl = jax.lax.dynamic_slice(x, (i, z, z), (r0, N1, N2))
+            return fft(fft(sl, axis=2, norm=norm),
+                       axis=1, norm=norm).astype(cdt)
+
+        @instrumented_jit(label='fft.lowmem.c2c.slab_b')
+        def slab_b(y, j):
+            z = jnp.zeros((), j.dtype)
+            sl = jax.lax.dynamic_slice(y, (z, j, z), (N0, r1, N2))
+            return jnp.transpose(fft(sl, axis=0, norm=norm), (1, 0, 2))
+
+        zeros_mid = jax.jit(lambda: jnp.zeros((N0, N1, N2), cdt))
+        zeros_out = jax.jit(lambda: jnp.zeros((N1, N0, N2), cdt))
+        loops = (N0 // r0, r0, N1 // r1, r1)
+        upd_a, upd_b = _upd_row, _upd_row
+        stages = ('c2c.fftz_ffty', 'c2c.fftx')
+    else:
+        # pass A: undo the x-axis fft (axis 1 of the transposed
+        # layout) over ky-slabs, written back in (x, ky, kz) order;
+        # pass B: ifft y + ifft z over x-slabs
+        @instrumented_jit(label='fft.lowmem.c2c.islab_a')
+        def slab_a(y, j):
+            z = jnp.zeros((), j.dtype)
+            sl = jax.lax.dynamic_slice(y, (j, z, z), (r1, N0, N2))
+            return jnp.transpose(fft(sl, axis=1, norm=norm),
+                                 (1, 0, 2)).astype(cdt)
+
+        @instrumented_jit(label='fft.lowmem.c2c.islab_b')
+        def slab_b(zf, i):
+            z = jnp.zeros((), i.dtype)
+            sl = jax.lax.dynamic_slice(zf, (i, z, z), (r0, N1, N2))
+            return fft(fft(sl, axis=1, norm=norm), axis=2, norm=norm)
+
+        zeros_mid = jax.jit(lambda: jnp.zeros((N0, N1, N2), cdt))
+        zeros_out = jax.jit(lambda: jnp.zeros((N0, N1, N2), cdt))
+        loops = (N1 // r1, r1, N0 // r0, r0)
+        upd_a, upd_b = _upd_col, _upd_row
+        stages = ('c2c.ifftx', 'c2c.iffty_ifftz')
+    return (loops, stages, zeros_mid, zeros_out, slab_a,
+            instrumented_jit(upd_a, label='fft.lowmem.c2c.upd',
+                             donate_argnums=(0,)), slab_b,
+            instrumented_jit(upd_b, label='fft.lowmem.c2c.upd',
+                             donate_argnums=(0,)))
+
+
+def fftn_c2c_single_lowmem(x_box, inverse=False, norm=None,
+                           target=None):
+    """Eager single-device c2c 3-D FFT peaking at ~2 full-mesh buffers
+    (same ownership contract as :func:`rfftn_single_lowmem`: pass the
+    field in a one-element list, which is emptied).  Forward maps
+    (N0, N1, N2) -> transposed (N1, N0, N2); inverse is the exact
+    reverse.  This is the OOM-ladder rung the resilience Supervisor
+    degrades convpower's odd-multipole Ylm transforms onto (see
+    docs/RESILIENCE.md).  Not traceable: call outside jit."""
+    x = x_box.pop() if isinstance(x_box, list) else x_box
+    if target is None:
+        target = _fft_chunk_bytes() or 2 ** 31
+    progs = _lowmem_c2c_programs(x.shape, str(x.dtype), bool(inverse),
+                                 norm, int(target))
+    loops, stages, zeros_mid, zeros_out, slab_a, upd_a, slab_b, upd_b \
+        = progs
+    nA, rA, nB, rB = loops
+
+    emit = current_tracer() is not None
+    counter('fft.chunks').add(nA + nB)
+    with span_if(emit, 'fft.lowmem.c2c', inverse=bool(inverse),
+                 shape=[int(s) for s in x.shape], chunks=[nA, nB]):
+        mid = zeros_mid()
+        for k in range(nA):
+            mid = _lowmem_step(emit, upd_a, slab_a, mid, x, k, rA,
+                               stages[0])
+        del x  # input freed before pass B allocates its output
+
+        out = zeros_out()
+        for k in range(nB):
+            out = _lowmem_step(emit, upd_b, slab_b, out, mid, k, rB,
+                               stages[1])
+        return out
+
+
 def _rfftn_single_chunked(x, norm, target):
     """Single-device 3-D rFFT as three slab-chunked 1-D passes.
 
@@ -337,6 +452,14 @@ def dist_rfftn(x, mesh=None, norm=None):
     Returns
     -------
     jax.Array, global shape (N1, N0, N2//2 + 1), complex, sharded on axis 0.
+
+    Notes
+    -----
+    Single-device fields past ``fft_chunk_bytes`` dispatch to the
+    eager lowmem driver; via this entry point the peak is ~3
+    full-mesh buffers (the caller's reference to ``x`` stays live
+    through the transform).  For the driver's ~2-buffer ownership
+    contract call :func:`rfftn_single_lowmem` directly.
     """
     with span_if(not isinstance(x, jax.core.Tracer), 'fft.r2c',
                  nproc=mesh_size(mesh),
@@ -358,7 +481,9 @@ def _dist_rfftn_impl(x, mesh, norm):
                 # driven lowmem driver peaks ~1 full-mesh buffer lower
                 # than the in-jit chunked program and avoids eager
                 # multi-GB ops the backend may not support
-                return rfftn_single_lowmem([x], norm=norm,
+                box = [x]
+                x = None  # this frame's ref must not pin the input
+                return rfftn_single_lowmem(box, norm=norm,
                                            target=target)
             return _rfftn_single_chunked(x, norm, target)
         y = jnp.fft.rfftn(x, norm=norm)
@@ -408,7 +533,9 @@ def _dist_irfftn_impl(y, Nmesh2, mesh, norm):
         target = _fft_chunk_bytes()
         if target and y.nbytes > target:
             if not isinstance(y, jax.core.Tracer):
-                return irfftn_single_lowmem([y], Nmesh2, norm=norm,
+                box = [y]
+                y = None  # this frame's ref must not pin the input
+                return irfftn_single_lowmem(box, Nmesh2, norm=norm,
                                             target=target)
             return _irfftn_single_chunked(y, Nmesh2, norm, target)
         yt = jnp.transpose(y, (1, 0, 2))
@@ -505,6 +632,15 @@ def _dist_fftn_c2c_impl(x, mesh, inverse, norm):
     if nproc == 1:
         target = _fft_chunk_bytes()
         if target and x.nbytes > target:
+            if not isinstance(x, jax.core.Tracer):
+                # eager call on a concrete field (convpower's Ylm loop
+                # composes eagerly): the Python-driven lowmem driver,
+                # as for r2c/c2r above — eager multi-GB fori_loop
+                # programs are exactly what the backend may refuse
+                box = [x]
+                x = None  # this frame's ref must not pin the input
+                return fftn_c2c_single_lowmem(box, inverse=inverse,
+                                              norm=norm, target=target)
             return _fftn_c2c_single_chunked(x, inverse, norm, target)
         if inverse:
             y = jnp.transpose(x, (1, 0, 2))

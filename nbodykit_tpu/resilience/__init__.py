@@ -1,0 +1,43 @@
+"""nbodykit_tpu.resilience — checkpointed, retrying, fault-injectable
+execution for flaky TPU fleets.
+
+Round 5's verdict: after five rounds the north-star config has zero
+recorded TPU evidence — not because the code is slow, but because
+nothing survives a mid-run fault (the 1024³ record died
+``UNAVAILABLE`` mid-timing; the FKP proof and ``--prim`` died
+``RESOURCE_EXHAUSTED``).  The reference nbodykit inherits
+restartability from MPI batch schedulers (SURVEY §L0); a
+production-scale jax_graft system has to build the moral equivalent
+in.  Three pieces:
+
+- :mod:`.checkpoint` — :class:`CheckpointStore`: atomic (tmp+rename),
+  content-hashed (sha256 over state + array bytes) checkpoint/restore
+  of host-side pipeline state.  A SIGKILL mid-save leaves the
+  previous checkpoint intact; corruption is detected, never replayed.
+- :mod:`.supervise` — :class:`Supervisor`: classifies raised errors
+  (``UNAVAILABLE``/device loss vs ``RESOURCE_EXHAUSTED``/OOM vs
+  deadline) and applies per-class policy — bounded exponential-backoff
+  retries for transients, *graceful degradation* down the existing
+  FFT/paint memory ladder (:func:`default_ladder`) for OOM, immediate
+  re-raise for real bugs.
+- :mod:`.faults` — deterministic fault injection
+  (``set_options(faults='point@N:action')`` / ``$NBKIT_FAULTS``):
+  raise a real ``XlaRuntimeError`` of a chosen status at the Nth call
+  to a named :func:`fault_point`, or SIGKILL at a named checkpoint —
+  every recovery path is testable on the CPU mesh in tier-1.
+
+Wired in: ``bench.py``'s measurement reps checkpoint after every rep
+and resume on relaunch (records carry ``resumed: true``); the
+multi-host test worker runs its pipeline under a Supervisor.  Every
+retry / degradation / resume lands as a ``resilience.*`` span +
+counter (:mod:`..diagnostics`) and in the doctor's verdict block.
+Full guide: docs/RESILIENCE.md.
+"""
+
+from .checkpoint import CheckpointStore  # noqa: F401
+from .faults import (ACTIONS, InjectedFault, error_class,  # noqa: F401
+                     fault_counts, fault_point, parse_spec,
+                     reset_faults)
+from .supervise import (DEADLINE, FATAL, OOM, TRANSIENT,  # noqa: F401
+                        DegradationLadder, RetryPolicy, Supervisor,
+                        classify_error, default_ladder)

@@ -1,0 +1,158 @@
+"""Deterministic fault injection: make every recovery path testable.
+
+The faults this subsystem exists for — ``UNAVAILABLE`` tunnel deaths,
+``RESOURCE_EXHAUSTED`` OOMs, SIGKILLed workers — only occur on the
+real TPU fleet, which tier-1 never touches.  This harness injects
+them *deterministically* on the CPU mesh so the retry / degrade /
+resume machinery (:mod:`.supervise`, :mod:`.checkpoint`) is exercised
+by ordinary tests instead of waiting for the hardware to misbehave.
+
+Spec format (``set_options(faults=...)`` or ``$NBKIT_FAULTS``):
+
+    point@N:action[,point@N:action...]
+
+``point`` names a fault point (a host-side call site instrumented
+with :func:`fault_point` — e.g. ``bench.rep``, ``ckpt.write.<key>``,
+``<supervisor>.attempt``), ``N`` is the 1-based call count at which
+the rule fires (default 1), and ``action`` is one of:
+
+- ``unavailable`` / ``resource_exhausted`` / ``deadline`` /
+  ``internal`` — raise a real ``XlaRuntimeError`` (the class jax's
+  runtime raises; a plain RuntimeError subclass when jax is absent)
+  whose message carries the canonical gRPC status prefix, so error
+  classification sees exactly what the fleet produces;
+- ``kill`` — ``SIGKILL`` this process on the spot (no atexit, no
+  flush): the checkpoint-atomicity and resume paths see a true
+  mid-run death.
+
+Each rule fires exactly once (the call count passes ``N`` once per
+process).  Calls to points no rule targets cost one string lookup.
+Counting is per-process and deterministic, so a multi-process fleet
+given the same spec injects the same fault at the same logical step
+everywhere — collective-consistent by construction.
+"""
+
+import os
+import signal
+import threading
+
+from ..diagnostics import counter
+
+_lock = threading.Lock()
+_counts = {}
+_parsed = None          # (source_spec, rules)
+
+_STATUS_MESSAGES = {
+    'unavailable': 'UNAVAILABLE: injected fault at %s (call %d); '
+                   'socket closed',
+    'resource_exhausted': 'RESOURCE_EXHAUSTED: injected fault at %s '
+                          '(call %d); out of memory while allocating',
+    'deadline': 'DEADLINE_EXCEEDED: injected fault at %s (call %d)',
+    'internal': 'INTERNAL: injected fault at %s (call %d)',
+}
+ACTIONS = tuple(_STATUS_MESSAGES) + ('kill',)
+
+
+class InjectedFault(RuntimeError):
+    """Raised for injected faults when jax's XlaRuntimeError is not
+    importable (diagnostics-only environments)."""
+
+
+def error_class():
+    """The exception class injected errors are raised as: the real
+    ``XlaRuntimeError`` when jax is present (classification and any
+    caller except-clauses see the genuine article)."""
+    try:
+        from jax._src.lib import xla_client
+        return xla_client.XlaRuntimeError
+    except Exception:
+        return InjectedFault
+
+
+def _spec():
+    try:
+        from .. import _global_options
+    except ImportError:     # pragma: no cover - interpreter teardown
+        return None
+    try:
+        return _global_options['faults']
+    except KeyError:
+        return None
+
+
+def parse_spec(spec):
+    """``[(point, nth, action), ...]`` for a spec string; raises
+    ValueError on malformed rules (a typo'd spec must not silently
+    inject nothing)."""
+    rules = []
+    for part in str(spec).split(','):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, action = part.rpartition(':')
+        if not name:
+            raise ValueError('fault rule %r: expected point@N:action'
+                             % part)
+        action = action.strip().lower()
+        if action not in ACTIONS:
+            raise ValueError('fault rule %r: unknown action %r '
+                             '(choose %s)' % (part, action,
+                                              '/'.join(ACTIONS)))
+        point, at, nth = name.partition('@')
+        try:
+            n = int(nth) if at else 1
+        except ValueError:
+            raise ValueError('fault rule %r: call count %r is not an '
+                             'integer' % (part, nth))
+        rules.append((point.strip(), n, action))
+    return rules
+
+
+def _rules():
+    global _parsed
+    spec = _spec()
+    if not spec:
+        return ()
+    cached = _parsed
+    if cached is not None and cached[0] == spec:
+        return cached[1]
+    rules = tuple(parse_spec(spec))
+    _parsed = (spec, rules)
+    return rules
+
+
+def reset_faults():
+    """Clear per-process call counts + the parsed-spec cache (test
+    isolation; the spec itself lives in the options/env)."""
+    global _parsed
+    with _lock:
+        _counts.clear()
+        _parsed = None
+
+
+def fault_counts():
+    """Snapshot of per-point call counts (observability for tests)."""
+    with _lock:
+        return dict(_counts)
+
+
+def fault_point(name):
+    """Declare a named fault point.  Free when no spec is configured
+    or no rule targets ``name``; otherwise counts the call and fires
+    any rule matching (name, count)."""
+    rules = _rules()
+    if not rules:
+        return
+    mine = [r for r in rules if r[0] == name]
+    if not mine:
+        return
+    with _lock:
+        n = _counts[name] = _counts.get(name, 0) + 1
+    for _, nth, action in mine:
+        if nth != n:
+            continue
+        if action == 'kill':
+            # no flush, no atexit: the genuine mid-run death
+            os.kill(os.getpid(), signal.SIGKILL)
+        counter('resilience.faults.injected').add(1)
+        raise error_class()(_STATUS_MESSAGES[action] % (name, n))

@@ -32,10 +32,40 @@ echo "== shard-safety lint gate =="
 python -m nbodykit_tpu.lint --baseline lint_baseline.json \
     nbodykit_tpu/ tests/_multihost_worker.py
 
+# fault-injected resume smoke (docs/RESILIENCE.md): a 2-rep CPU bench
+# is SIGKILLed entering rep 2 by the fault harness, then relaunched —
+# the relaunch must resume from the checkpoint and flush one complete
+# record stamped resumed: true. This rehearses the round-5 evidence
+# loss end to end on every smoke run.
+echo "== fault-injected kill/resume smoke =="
+SMOKE_TMP=$(mktemp -d)
+trap 'rm -rf "$SMOKE_TMP"' EXIT
+smoke_env=(env JAX_PLATFORMS=cpu BENCH_REPS=2 BENCH_PHASES=0
+           BENCH_STAGED_PATH="$SMOKE_TMP/STAGED.json"
+           BENCH_DETAIL_PATH="$SMOKE_TMP/DETAIL.json"
+           BENCH_CKPT_DIR="$SMOKE_TMP/CKPT"
+           BENCH_TRACE_DIR="$SMOKE_TMP/TRACE")
+rc=0
+"${smoke_env[@]}" NBKIT_FAULTS='bench.rep@2:kill' \
+    python bench.py --config 32 2000 || rc=$?
+[ "$rc" -eq 137 ] || { echo "expected SIGKILL (137), got rc=$rc"; exit 1; }
+"${smoke_env[@]}" python bench.py --config 32 2000 > "$SMOKE_TMP/rec.json"
+python - "$SMOKE_TMP" <<'EOF'
+import json, os, sys
+tmp = sys.argv[1]
+rec = json.loads(open(os.path.join(tmp, 'rec.json')).read().strip().splitlines()[-1])
+assert rec.get('resumed') is True, rec
+assert rec.get('value', -1) > 0 and rec.get('unit') == 's', rec
+assert not [f for f in os.listdir(os.path.join(tmp, 'CKPT'))
+            if f.endswith('.ckpt.json')], 'checkpoint not consumed'
+print('resume smoke OK: %(metric)s resumed -> %(value)s s' % rec)
+EOF
+
 echo "== tier-1 fast subset =="
 python -m pytest \
     tests/test_diagnostics.py \
     tests/test_diagnostics_analyze.py \
+    tests/test_resilience.py \
     tests/test_lint.py \
     tests/test_jax_compat.py \
     tests/test_pmesh.py \

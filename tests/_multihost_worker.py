@@ -99,33 +99,48 @@ def main():
                                 num_processes=nprocs, process_id=pid)
     if mode == 'batch':
         return main_batch()
-    with diagnostics.span('multihost.pipeline', nprocs=nprocs,
-                          proc=pid):
-        mesh = world_mesh()
-        ndev = len(jax.devices())
-        _barrier(mesh, 'start')
 
-        from nbodykit_tpu.pmesh import ParticleMesh
-        pm = ParticleMesh(Nmesh=16, BoxSize=50.0, dtype='f4', comm=mesh)
+    def pipeline():
+        with diagnostics.span('multihost.pipeline', nprocs=nprocs,
+                              proc=pid):
+            mesh = world_mesh()
+            ndev = len(jax.devices())
+            _barrier(mesh, 'start')
 
-        N = 4096
-        pos_np = np.random.RandomState(7).uniform(0, 50.0, (N, 3)) \
-            .astype('f4')
+            from nbodykit_tpu.pmesh import ParticleMesh
+            pm = ParticleMesh(Nmesh=16, BoxSize=50.0, dtype='f4',
+                              comm=mesh)
 
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from nbodykit_tpu.parallel.runtime import AXIS
-        sharding = NamedSharding(mesh, P(AXIS, None))
+            N = 4096
+            pos_np = np.random.RandomState(7).uniform(0, 50.0, (N, 3)) \
+                .astype('f4')
 
-        def cb(index):
-            return pos_np[index]
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from nbodykit_tpu.parallel.runtime import AXIS
+            sharding = NamedSharding(mesh, P(AXIS, None))
 
-        pos = jax.make_array_from_callback((N, 3), sharding, cb)
+            def cb(index):
+                return pos_np[index]
 
-        field = pm.paint(pos, 1.0, resampler='cic')
-        total = float(jnp.sum(field.astype(jnp.float32)))
-        c = pm.r2c(field)
-        p2 = float(jnp.sum(jnp.abs(c) ** 2))
-        _barrier(mesh, 'end')
+            pos = jax.make_array_from_callback((N, 3), sharding, cb)
+
+            field = pm.paint(pos, 1.0, resampler='cic')
+            total = float(jnp.sum(field.astype(jnp.float32)))
+            c = pm.r2c(field)
+            p2 = float(jnp.sum(jnp.abs(c) ** 2))
+            _barrier(mesh, 'end')
+        return ndev, total, p2
+
+    # supervised (nbodykit_tpu.resilience): transient device loss is
+    # retried with backoff, and every process given the same
+    # $NBKIT_FAULTS spec injects/retries at the same logical step —
+    # collective-consistent, so the retried pipeline re-enters its
+    # barriers together. Retry/degrade events land in the per-process
+    # trace the analyzer merges.
+    from nbodykit_tpu.resilience import RetryPolicy, Supervisor
+    sup = Supervisor('multihost.pipeline',
+                     policy=RetryPolicy(max_retries=1, base_s=0.1))
+    ndev, total, p2 = sup.run(pipeline)
     print("RESULT %d %.6e %.6e" % (ndev, total, p2), flush=True)
 
 
