@@ -441,6 +441,12 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
         **({"paint_method_overridden": "sort->scatter (HBM)"}
            if overridden else {}),
     }
+    # which tuned configuration this measurement actually ran with
+    # (explicit/default/cache per knob) — a bench number without its
+    # config is not reproducible evidence (nbodykit_tpu.tune)
+    from nbodykit_tpu.tune.resolve import tuned_snapshot
+    rec['tuned'] = tuned_snapshot(nmesh=Nmesh, npart=Npart,
+                                  dtype='f4', nproc=pm.nproc)
     # per-rep checkpoints keyed by metric (the TPU + forced-CPU worker
     # pair never collide); a relaunch after a mid-rep death resumes
     # here instead of restarting the rung
@@ -720,9 +726,19 @@ def run_prim(n=10_000_000, reps=3):
     the scatter/sort/gather rates decide which kernel wins and none of
     them are predictable from specs (TPU scatter serializes; sort is a
     bitonic network; gather throughput varies with layout).
+
+    Runs under a ladder-equipped Supervisor like run_fkp (round 5's
+    --prim died RESOURCE_EXHAUSTED on the chip with no response):
+    UNAVAILABLE/deadline get bounded-backoff retries, an OOM steps
+    down the FFT/paint memory ladder and re-runs the primitive —
+    degrading instead of dying, with the supervisor's activity
+    recorded on the emitted record.
     """
     jax = _setup_jax()
     import jax.numpy as jnp
+    from nbodykit_tpu.resilience import Supervisor, default_ladder
+
+    sup = Supervisor('bench.prim', ladder=default_ladder())
 
     key = jax.random.key(11)
     M = 134_217_728  # 512^3
@@ -736,15 +752,21 @@ def run_prim(n=10_000_000, reps=3):
 
     def t(name, fn, *args):
         f = jax.jit(fn)
-        try:
+
+        def attempt():
             _sync(jax, f(*args))                 # compile + warm
             t0 = time.time()
             for _ in range(reps):
                 _sync(jax, f(*args))
-            dt = (time.time() - t0) / reps
+            return (time.time() - t0) / reps
+
+        try:
+            dt = sup.run(attempt)
             out[name] = {"s": round(dt, 4),
                          "ns_per_elt": round(dt / n * 1e9, 2)}
         except Exception as e:
+            # the primitive is infeasible even degraded; record and
+            # move on — one dead primitive must not kill the sweep
             out[name] = {"error": str(e)[:200]}
 
     big = jnp.zeros(M, jnp.float32)
@@ -779,8 +801,16 @@ def run_prim(n=10_000_000, reps=3):
           lambda k: pass_rank_hist_pallas(k % 130, 130)[0], small)
     except Exception as e:          # lowering/import failure is itself
         out['radix_rank_pallas_D130'] = {"error": str(e)[:200]}  # data
-    return _stamp({"metric": "prim_microbench_n%.0e" % n, "n": n,
-                   "platform": jax.devices()[0].platform, "prims": out})
+    rec = {"metric": "prim_microbench_n%.0e" % n, "n": n,
+           "platform": jax.devices()[0].platform, "prims": out}
+    retr = [e for e in sup.events if e['kind'] == 'retries']
+    degr = [e for e in sup.events if e['kind'] == 'degradations']
+    if retr:
+        rec['retries'] = len(retr)
+    if degr:
+        rec['degradations'] = [dict(e.get('detail', {}),
+                                    rung=e.get('rung')) for e in degr]
+    return _stamp(rec)
 
 
 def run_fftbw(Nmesh=512, reps=3):
@@ -873,12 +903,15 @@ def run_paint(Nmesh, Npart, method='scatter', reps=3):
                                     return_dropped=True)[0])
     dt, _ = _time_fn(jax, fn, (pos,), reps,
                      label='paint_%s' % method_label)
+    from nbodykit_tpu.tune.resolve import tuned_snapshot
     return _stamp({
         "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"
                   % (Nmesh, Npart, method_label),
         "value": round(dt, 4), "unit": "s",
         "mpart_per_s": round(Npart / dt / 1e6, 1),
         "platform": jax.devices()[0].platform,
+        "tuned": tuned_snapshot(nmesh=Nmesh, npart=Npart, dtype='f4',
+                                nproc=pm.nproc),
     })
 
 

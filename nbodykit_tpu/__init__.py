@@ -42,8 +42,11 @@ _default_options = {
     # default resampler window
     'resampler': 'cic',
     # paint kernel: 'scatter' (chunked scatter-add), 'sort'
-    # (scatter-free sort + segmented reduction) or 'mxu'
-    # (tile-bucketed batched-matmul deposit; see ops/paint.py)
+    # (scatter-free sort + segmented reduction), 'mxu'
+    # (tile-bucketed batched-matmul deposit; see ops/paint.py) or
+    # 'auto' (the measured winner from the tune cache for this
+    # platform/shape — nbodykit_tpu.tune, docs/TUNE.md; a cold cache
+    # falls back to 'scatter' with zero trial overhead)
     'paint_method': 'scatter',
     # bucket-capacity slack for the 'mxu' paint kernel
     'paint_bucket_slack': 2.0,
@@ -58,8 +61,14 @@ _default_options = {
     # single-device FFTs whose complex output exceeds this many bytes
     # run as slab-chunked per-axis passes (a single FFT op over a
     # multi-GB buffer exceeds TPU compiler limits; see parallel/dfft).
-    # 0 disables chunking.
+    # 0 disables chunking; 'auto' consults the tune cache
+    # (nbodykit_tpu.tune) and falls back to 2**31 when cold.
     'fft_chunk_bytes': 2 ** 31,
+    # performance-database file for 'auto' option resolution and
+    # nbodykit-tpu-tune (nbodykit_tpu.tune, docs/TUNE.md). None uses
+    # the committed repo-root TUNE_CACHE.json; seeded from
+    # $NBKIT_TUNE_CACHE so detached workers can be pointed elsewhere.
+    'tune_cache': os.environ.get('NBKIT_TUNE_CACHE') or None,
     # telemetry sink: None disables; a path enables the span tracer +
     # crash-safe JSONL trace (nbodykit_tpu.diagnostics, docs/
     # OBSERVABILITY.md). Seeded from $NBKIT_DIAGNOSTICS so detached
@@ -148,12 +157,22 @@ class set_options(object):
     resampler : str
         default window: 'nnb', 'cic', 'tsc', 'pcs'.
     paint_method : str
-        'scatter', 'sort' or 'mxu' — the local deposit kernel.
+        'scatter', 'sort', 'mxu' — the local deposit kernel — or
+        'auto': the measured winner recorded in the tune cache for
+        this platform/device/shape (:mod:`nbodykit_tpu.tune`,
+        docs/TUNE.md); a cold cache resolves to 'scatter' at zero
+        trial cost.
     paint_bucket_slack : float
         bucket-capacity slack factor for the 'mxu' paint kernel.
-    fft_chunk_bytes : int
+    fft_chunk_bytes : int or 'auto'
         single-device FFTs with complex output larger than this run as
-        slab-chunked per-axis passes (0 disables).
+        slab-chunked per-axis passes (0 disables); 'auto' consults the
+        tune cache, falling back to 2**31 when cold.
+    tune_cache : str or None
+        path of the performance database consulted by 'auto' options
+        and written by ``nbodykit-tpu-tune``; None (the default) uses
+        the committed repo-root ``TUNE_CACHE.json``.  Seeded from
+        ``$NBKIT_TUNE_CACHE``.
     diagnostics : str or None
         path of the telemetry sink (a directory, or a ``*.jsonl``
         file): enables the span tracer + metrics of

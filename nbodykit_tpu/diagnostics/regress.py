@@ -262,6 +262,23 @@ def lint_summary(root):
         return {'error': str(e)}
 
 
+def tune_summary(root, now=None):
+    """Tuner posture for the round record: how many measured entries
+    the committed TUNE_CACHE.json carries, how many are stale (older
+    than the 30-day bar) or recorded infeasible candidates, and which
+    platform/device-kind signatures they were measured on — tracked
+    per round like a bench metric, so a decaying database is visible
+    in BENCH_HISTORY.json.  ``None`` when no cache file exists; never
+    raises."""
+    try:
+        from ..tune.cache import cache_summary
+        epoch = time.time() if now is None else now
+        return cache_summary(os.path.join(root, 'TUNE_CACHE.json'),
+                             now=epoch)
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+
+
 def resilience_summary(root, now=None):
     """Resilience posture for the round record: how many committed
     records were produced by a resumed run, and whether checkpoints
@@ -310,6 +327,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'stale_hours': stale_hours,
         'rounds': entries,
         'lint': lint_summary(root),
+        'tune': tune_summary(root, now=now),
         'resilience': resilience_summary(root, now=now),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
@@ -369,6 +387,21 @@ def render_regress(history):
                            res.get('oldest_checkpoint_hours', '?')))
         if bits:
             w('  resilience: %s' % '; '.join(bits))
+    tune = history.get('tune')
+    if tune is not None:
+        if 'error' in tune:
+            w('  tune: MALFORMED cache (%s)' % tune['error'])
+        else:
+            w('  tune: %d entr%s in TUNE_CACHE.json (%s)%s%s'
+              % (tune['entries'],
+                 'y' if tune['entries'] == 1 else 'ies',
+                 ','.join(tune.get('platforms', [])) or '-',
+                 ', %d stale (>%.0f d)'
+                 % (tune['stale'], tune.get('stale_days', 30))
+                 if tune.get('stale') else '',
+                 ', %d infeasible candidate(s) recorded'
+                 % tune['infeasible'] if tune.get('infeasible')
+                 else ''))
     lint = history.get('lint')
     if lint is not None:
         if 'error' in lint:

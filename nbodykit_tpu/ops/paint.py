@@ -431,12 +431,21 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
         ``return_overflow=True``); callers retry with doubled slack.
     deposit : 'xla' (one-hot expansions materialized by XLA),
         'pallas' (fused VMEM kernel, ops/paint_pallas.py — interpreted
-        off-TPU), or 'auto' (currently 'xla' everywhere until the
-        Pallas kernel is proven over the axon tunnel; see
-        ops/radix.py DEFAULT_ENGINE for the same gating).
+        off-TPU), or 'auto': cache-then-fallback resolution
+        (nbodykit_tpu.tune, docs/TUNE.md) — the measured winner's
+        deposit engine when the tune cache holds a paint entry for
+        this platform/shape (nearest shape class otherwise), falling
+        back to 'xla' (the proven-everywhere engine) on a cold cache
+        at zero trial cost.  ``nbodykit-tpu-tune`` populates the
+        cache offline; until a run commits a 'pallas' win there, the
+        resolution is byte-identical to the old hard-coded 'xla'.
     """
     if deposit == 'auto':
-        deposit = 'xla'
+        from ..tune.resolve import resolve_paint_deposit
+        deposit = resolve_paint_deposit(
+            nmesh=int(period[0]) if period is not None
+            else int(shape[0]),
+            npart=int(pos.shape[0]))
     if deposit not in ('xla', 'pallas'):
         raise ValueError("unknown deposit %r (choose "
                          "'auto'/'xla'/'pallas')" % (deposit,))

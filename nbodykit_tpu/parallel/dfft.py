@@ -47,9 +47,19 @@ from ..diagnostics import counter, current_tracer, histogram, \
 install_compile_telemetry()
 
 
-def _fft_chunk_bytes():
+def _fft_chunk_bytes(shape=None, dtype=None):
+    """The effective chunking target.  An integer option is used
+    verbatim; ``'auto'`` resolves through the tune cache
+    (nbodykit_tpu.tune — the measured winner for the nearest mesh
+    class on this platform, else the 2**31 default at zero trial
+    cost).  ``shape``/``dtype`` of the field being transformed sharpen
+    the cache lookup when the caller has them."""
     from .. import _global_options
-    return int(_global_options['fft_chunk_bytes'])
+    v = _global_options['fft_chunk_bytes']
+    if not isinstance(v, bool) and isinstance(v, (int, float)):
+        return int(v)
+    from ..tune.resolve import resolve_fft_chunk_bytes
+    return resolve_fft_chunk_bytes(shape=shape, dtype=dtype or 'f4')
 
 
 def _lowmem_step(emit, upd, slab, buf, arr, k, r, stage):
@@ -104,7 +114,7 @@ def rfftn_single_lowmem(x_box, norm=None, target=None):
     else:
         x = x_box
     if target is None:
-        target = _fft_chunk_bytes() or 2 ** 31
+        target = _fft_chunk_bytes(x.shape, x.dtype) or 2 ** 31
     progs = _lowmem_programs(x.shape, str(x.dtype), norm, int(target))
     r0, r1, zeros_y, zeros_out, slab_a, upd_a, slab_b, upd_b = progs
     N0, N1, _ = x.shape
@@ -135,7 +145,7 @@ def irfftn_single_lowmem(y_box, Nmesh2, norm=None, target=None):
     one-element list; ~2 full-mesh buffers peak)."""
     y = y_box.pop() if isinstance(y_box, list) else y_box
     if target is None:
-        target = _fft_chunk_bytes() or 2 ** 31
+        target = _fft_chunk_bytes(y.shape, y.dtype) or 2 ** 31
     progs = _lowmem_inv_programs(y.shape, str(y.dtype), int(Nmesh2),
                                  norm, int(target))
     r1, r0, zeros_z, zeros_out, slab_a, upd_a, slab_b, upd_b = progs
@@ -334,7 +344,7 @@ def fftn_c2c_single_lowmem(x_box, inverse=False, norm=None,
     docs/RESILIENCE.md).  Not traceable: call outside jit."""
     x = x_box.pop() if isinstance(x_box, list) else x_box
     if target is None:
-        target = _fft_chunk_bytes() or 2 ** 31
+        target = _fft_chunk_bytes(x.shape, x.dtype) or 2 ** 31
     progs = _lowmem_c2c_programs(x.shape, str(x.dtype), bool(inverse),
                                  norm, int(target))
     loops, stages, zeros_mid, zeros_out, slab_a, upd_a, slab_b, upd_b \
@@ -471,7 +481,7 @@ def _dist_rfftn_impl(x, mesh, norm):
     nproc = mesh_size(mesh)
     if nproc == 1:
         N0, N1, N2 = x.shape
-        target = _fft_chunk_bytes()
+        target = _fft_chunk_bytes(x.shape, x.dtype)
         out_bytes = N0 * N1 * (N2 // 2 + 1) * (
             8 if x.dtype.itemsize <= 4 else 16)
         if target and out_bytes > target:
@@ -530,7 +540,7 @@ def dist_irfftn(y, Nmesh2, mesh=None, norm=None):
 def _dist_irfftn_impl(y, Nmesh2, mesh, norm):
     nproc = mesh_size(mesh)
     if nproc == 1:
-        target = _fft_chunk_bytes()
+        target = _fft_chunk_bytes(y.shape, y.dtype)
         if target and y.nbytes > target:
             if not isinstance(y, jax.core.Tracer):
                 box = [y]
@@ -630,7 +640,7 @@ def _dist_fftn_c2c_impl(x, mesh, inverse, norm):
     nproc = mesh_size(mesh)
     fft = jnp.fft.ifft if inverse else jnp.fft.fft
     if nproc == 1:
-        target = _fft_chunk_bytes()
+        target = _fft_chunk_bytes(x.shape, x.dtype)
         if target and x.nbytes > target:
             if not isinstance(x, jax.core.Tracer):
                 # eager call on a concrete field (convpower's Ylm loop

@@ -234,9 +234,21 @@ class ParticleMesh(object):
         cell = jnp.mod(jnp.floor(cpos[:, 0]).astype(jnp.int32), N0)
         return cell // n0
 
+    def _paint_config(self, npart):
+        """The effective paint-kernel configuration for one call:
+        current options with every ``'auto'`` resolved through the
+        tune cache (:mod:`nbodykit_tpu.tune` — measured winner for
+        this platform/device-count/shape when one exists, today's
+        defaults otherwise, zero trial overhead either way)."""
+        from .tune.resolve import resolve_paint
+        return resolve_paint(nmesh=int(self.Nmesh[0]), npart=int(npart),
+                             dtype=self.dtype, nproc=self.nproc)
+
     def exchange_capacity(self, pos, slack=1.05, shift=0.0):
         """Two-pass counted exchange, pass 1 (run EAGERLY): the exact
         per-(src,dst) routing count for these positions, with slack.
+        ``slack='auto'`` consults the tune cache (exchange op) and
+        falls back to 1.05 when cold.
 
         Pass the result as ``capacity=`` to a *traced* :meth:`paint` /
         :meth:`readout` (with ``return_dropped=True``) so the
@@ -252,6 +264,10 @@ class ParticleMesh(object):
         from .parallel.exchange import auto_capacity
         if self.nproc == 1:
             return int(pos.shape[0])
+        if slack == 'auto':
+            from .tune.resolve import resolve_exchange_slack
+            slack = resolve_exchange_slack(npart=int(pos.shape[0]),
+                                           nproc=self.nproc)
         dest = self._route_dest(self._to_cell_units(pos) - shift)
         return auto_capacity(dest, self.nproc, slack=slack)
 
@@ -289,8 +305,11 @@ class ParticleMesh(object):
         if current_tracer() is None or not trace_state_clean():
             return self._paint_impl(pos, mass, resampler, out, shift,
                                     capacity, return_dropped)
-        method = _global_options['paint_method']
         npart = int(pos.shape[0])
+        # the RESOLVED kernel labels the span/histograms — with
+        # paint_method='auto' the trace must show which kernel ran,
+        # not the sentinel
+        method = self._paint_config(npart)['paint_method']
         t0 = time.perf_counter()
         with span('paint', method=method, npart=npart,
                   nproc=self.nproc,
@@ -314,10 +333,20 @@ class ParticleMesh(object):
         npart = pos.shape[0]
         massa = jnp.broadcast_to(
             jnp.asarray(mass, self.dtype), (npart,))
-        chunk = _global_options['paint_chunk_size']
+        # 'auto' options resolve through the tune cache here, at
+        # dispatch time (cold cache -> today's defaults, no trials)
+        pcfg = self._paint_config(npart)
+        chunk = pcfg['paint_chunk_size']
 
-        pm_method = _global_options['paint_method']
+        pm_method = pcfg['paint_method']
         traced = isinstance(cpos, jax.core.Tracer)
+        if traced and pm_method == 'mxu' and not return_dropped \
+                and pcfg['source'] != 'explicit':
+            # a tune-cache winner must not impose the traced-mxu
+            # overflow contract (return_dropped) on a caller who asked
+            # for 'auto': fall back to the contract-free scatter
+            # kernel for this call; only an EXPLICIT 'mxu' raises below
+            pm_method = 'scatter'
         if traced and pm_method == 'mxu' and not return_dropped:
             # same contract as an explicit exchange capacity: the mxu
             # bucket capacity is slack-sized, not provably sufficient,
@@ -339,8 +368,8 @@ class ParticleMesh(object):
                     return (paint_local_sorted(*a, **kw),
                             jnp.zeros((), jnp.int32))
             elif pm_method == 'mxu':
-                order = _global_options['paint_order']
-                dep = _global_options['paint_deposit']
+                order = pcfg['paint_order']
+                dep = pcfg['paint_deposit']
 
                 def kern(*a, **kw):
                     return paint_local_mxu(*a, slack=mxu_slack,
@@ -619,6 +648,12 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     pos_b = 3 * item * npart / ndev
     if paint_chunk is None:
         chunk = _global_options['paint_chunk_size']
+        if isinstance(chunk, bool) or not isinstance(chunk,
+                                                     (int, float)):
+            # 'auto' (tune-cache resolution): plan with the effective
+            # concrete value
+            from .tune.resolve import effective_int_option
+            chunk = effective_int_option('paint_chunk_size')
     else:
         chunk = paint_chunk
     live = min(npart / ndev, chunk)

@@ -111,11 +111,19 @@ class DegradationLadder(object):
 
 
 def _halve_option(option, floor):
-    """A ladder rung halving a global option (not below ``floor``)."""
+    """A ladder rung halving a global option (not below ``floor``).
+    An ``'auto'`` option halves from its tune-cache-resolved effective
+    value — and the rung pins it to the concrete result, so every
+    later attempt in this degraded run stays below the OOM point
+    instead of re-resolving back up."""
     def apply():
         import nbodykit_tpu
         from .. import _global_options
-        cur = int(_global_options[option])
+        from ..tune.resolve import effective_int_option
+        cur = _global_options[option]
+        if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+            cur = effective_int_option(option)
+        cur = int(cur)
         new = max(int(floor), cur // 2)
         nbodykit_tpu.set_options(**{option: new})
         return {option: new, 'was': cur}

@@ -1,0 +1,179 @@
+"""Dispatch-time resolution of ``'auto'`` options through the cache.
+
+``set_options(paint_method='auto')`` / ``fft_chunk_bytes='auto'`` /
+``exchange_capacity(..., slack='auto')`` mean "use the measured
+winner for this platform/shape if one exists, else today's default".
+The contract is:
+
+- **cold cache: zero trial overhead.**  Resolution never runs a
+  trial; a miss costs one ``stat`` plus dict lookups and returns the
+  same defaults the option would have had before this subsystem
+  existed.  Populating the cache is an offline act
+  (``nbodykit-tpu-tune``).
+- **warm cache: the measured winner wins.**  Exact shape-class hits
+  are preferred; a nearest-class fallback (same platform / device
+  kind / op / dtype) is used otherwise and flagged as such.
+- an explicit (non-``'auto'``) option is never overridden — the cache
+  only answers questions it was asked.
+
+Every consulted resolution bumps ``tune.resolve.hit`` /
+``tune.resolve.nearest`` / ``tune.resolve.miss`` so a trace shows
+which of a run's choices were measured and which were defaults.
+"""
+
+from .cache import TuneCache, device_signature, shape_class
+
+# the pre-tuner defaults, used verbatim on a cold cache
+FALLBACKS = {
+    'paint_method': 'scatter',
+    'paint_order': 'auto',          # hardware heuristic (ops/radix.py)
+    'paint_deposit': 'xla',
+    'paint_chunk_size': 1024 * 1024 * 16,
+    'fft_chunk_bytes': 2 ** 31,
+    'exchange_slack': 1.05,
+}
+
+
+def _current(name):
+    from .. import _global_options
+    try:
+        return _global_options[name]
+    except KeyError:
+        return None
+
+
+def _consult(op, sclass, dtype, nproc):
+    """``(winner_options, source)`` for one cache question; source is
+    ``'cache'`` / ``'cache-nearest'`` / ``'default'``."""
+    from ..diagnostics import counter
+    sig = device_signature(count=nproc)
+    entry, match = TuneCache().lookup(sig[0], sig[1], sig[2], op,
+                                      sclass, dtype)
+    if entry is None:
+        counter('tune.resolve.miss').add(1)
+        return {}, 'default'
+    if match == 'exact':
+        counter('tune.resolve.hit').add(1)
+        return dict(entry['winner']), 'cache'
+    counter('tune.resolve.nearest').add(1)
+    return dict(entry['winner']), 'cache-nearest'
+
+
+def resolve_paint(nmesh, npart, dtype='f4', nproc=1):
+    """The effective paint configuration for one call: current options
+    with every ``'auto'`` replaced by the cache winner (or the
+    fallback).  Returns the four paint options plus ``source``
+    (``'explicit'`` when nothing was ``'auto'``) and, when the cache
+    answered, ``winner_name``."""
+    opts = {k: _current(k) for k in
+            ('paint_method', 'paint_order', 'paint_deposit',
+             'paint_chunk_size')}
+    # paint_order/'auto' and paint_deposit/'auto' keep their hardware-
+    # heuristic meaning unless the METHOD itself asked the tuner:
+    # consulting the cache for every default-configured paint would
+    # let a committed database silently re-style explicit benchmarks
+    asked = (opts['paint_method'] == 'auto'
+             or opts['paint_chunk_size'] == 'auto')
+    cfg = dict(opts)
+    cfg['source'] = 'explicit'
+    if asked:
+        winner, source = _consult(
+            'paint', shape_class(nmesh=nmesh, npart=npart), dtype,
+            nproc)
+        cfg['source'] = source
+        if winner:
+            cfg['winner_name'] = winner.get('paint_method')
+        # only the options the caller left 'auto' take the winner's
+        # value — an explicit paint_order/'radix' next to
+        # paint_method='auto' stays explicit
+        for key in ('paint_method', 'paint_order', 'paint_deposit',
+                    'paint_chunk_size'):
+            if opts[key] == 'auto':
+                cfg[key] = winner.get(key, FALLBACKS[key])
+    # concreteness guarantees: the 'auto' sentinel survives only for
+    # paint_order (the hardware heuristic in ops/radix dispatch)
+    if cfg['paint_method'] == 'auto':
+        cfg['paint_method'] = FALLBACKS['paint_method']
+    if isinstance(cfg['paint_chunk_size'], bool) or \
+            not isinstance(cfg['paint_chunk_size'], (int, float)):
+        cfg['paint_chunk_size'] = FALLBACKS['paint_chunk_size']
+    cfg['paint_chunk_size'] = int(cfg['paint_chunk_size'])
+    return cfg
+
+
+def resolve_paint_deposit(nmesh=None, npart=None, dtype='f4', nproc=1):
+    """The deposit engine for ``deposit='auto'`` in
+    :func:`~nbodykit_tpu.ops.paint.paint_local_mxu`: the cache
+    winner's ``paint_deposit`` when a measured paint entry exists for
+    this platform/shape, else ``'xla'`` (the proven-everywhere
+    engine)."""
+    winner, _ = _consult('paint',
+                         shape_class(nmesh=nmesh, npart=npart)
+                         if (nmesh or npart) else 'mesh1',
+                         dtype, nproc)
+    dep = winner.get('paint_deposit', FALLBACKS['paint_deposit'])
+    return FALLBACKS['paint_deposit'] if dep == 'auto' else dep
+
+
+def resolve_fft_chunk_bytes(shape=None, dtype='f4', nproc=1):
+    """Concrete ``fft_chunk_bytes`` when the option is ``'auto'``:
+    the cache winner for the nearest measured mesh class, else the
+    pre-tuner default (2**31)."""
+    v = _current('fft_chunk_bytes')
+    if not isinstance(v, bool) and isinstance(v, (int, float)):
+        return int(v)
+    nmesh = int(max(shape)) if shape else None
+    winner, _ = _consult('fft',
+                         shape_class(nmesh=nmesh) if nmesh
+                         else 'mesh1', dtype, nproc)
+    return int(winner.get('fft_chunk_bytes',
+                          FALLBACKS['fft_chunk_bytes']))
+
+
+def resolve_exchange_slack(npart=None, nproc=1):
+    """Concrete counted-exchange slack for ``slack='auto'``: the cache
+    winner for the nearest measured particle class, else 1.05 (the
+    pre-tuner default of
+    :meth:`~nbodykit_tpu.pmesh.ParticleMesh.exchange_capacity`)."""
+    winner, _ = _consult('exchange',
+                         shape_class(npart=npart) if npart
+                         else 'part1e0', 'f4', nproc)
+    return float(winner.get('exchange_slack',
+                            FALLBACKS['exchange_slack']))
+
+
+def effective_int_option(option):
+    """A concrete integer for a possibly-``'auto'`` option — the value
+    the resilience ladder halves from
+    (:func:`~nbodykit_tpu.resilience.supervise.default_ladder`)."""
+    v = _current(option)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        if option == 'fft_chunk_bytes':
+            return resolve_fft_chunk_bytes()
+        return int(FALLBACKS[option])
+    return int(v)
+
+
+def tuned_snapshot(nmesh=None, npart=None, dtype='f4', nproc=1):
+    """What a bench record stamps next to its measurement: the
+    effective paint configuration and FFT chunk target this
+    measurement actually ran with, plus where each came from
+    ('explicit' / 'default' / 'cache' / 'cache-nearest') and the cache
+    file consulted."""
+    paint = resolve_paint(nmesh=nmesh, npart=npart, dtype=dtype,
+                          nproc=nproc)
+    fft_v = _current('fft_chunk_bytes')
+    fft_auto = not isinstance(fft_v, (int, float)) \
+        or isinstance(fft_v, bool)
+    return {
+        'paint_method': paint['paint_method'],
+        'paint_order': paint['paint_order'],
+        'paint_deposit': paint['paint_deposit'],
+        'paint_chunk_size': paint['paint_chunk_size'],
+        'paint_source': paint['source'],
+        'fft_chunk_bytes': resolve_fft_chunk_bytes(
+            shape=(nmesh,) * 3 if nmesh else None, dtype=dtype,
+            nproc=nproc),
+        'fft_source': 'auto' if fft_auto else 'explicit',
+        'cache': TuneCache().path,
+    }

@@ -32,6 +32,15 @@ echo "== shard-safety lint gate =="
 python -m nbodykit_tpu.lint --baseline lint_baseline.json \
     nbodykit_tpu/ tests/_multihost_worker.py
 
+# autotuner gates (docs/TUNE.md): the bounded --dry-run proves the
+# deterministic trial plan still builds without touching a device;
+# --validate fails the smoke run on a malformed committed
+# TUNE_CACHE.json (a broken database must never silently steer
+# dispatch)
+echo "== tune: dry-run plan + cache validation gate =="
+python -m nbodykit_tpu.tune --dry-run --devices 8 > /dev/null
+python -m nbodykit_tpu.tune --validate
+
 # fault-injected resume smoke (docs/RESILIENCE.md): a 2-rep CPU bench
 # is SIGKILLed entering rep 2 by the fault harness, then relaunched —
 # the relaunch must resume from the checkpoint and flush one complete
@@ -66,6 +75,7 @@ python -m pytest \
     tests/test_diagnostics.py \
     tests/test_diagnostics_analyze.py \
     tests/test_resilience.py \
+    tests/test_tune.py \
     tests/test_lint.py \
     tests/test_jax_compat.py \
     tests/test_pmesh.py \
