@@ -185,6 +185,10 @@ class ConvolvedFFTPower(object):
             meta2 = dict(rfield2.attrs)
             if not np.allclose(meta1['alpha'], meta2['alpha'],
                                rtol=1e-3):
+                # NBK103 (baselined, audited): raises between the two
+                # forward FFTs' collectives, but alpha is global
+                # catalog metadata identical on every rank — all ranks
+                # raise together, the exception path is rank-uniform
                 raise ValueError(
                     "cross-correlations require the same FKPCatalog "
                     "geometry (matching alpha)")

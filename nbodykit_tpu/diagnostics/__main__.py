@@ -218,6 +218,39 @@ def _compile_miss_labels(trace):
     return labels
 
 
+def _device_watermark_bytes(trace):
+    """Per-device live-byte watermarks: the ``device.<d>.live_bytes``
+    gauge maxima from the live registry, merged (per-device max) with
+    gauge records found in the analyzed trace directory."""
+    from . import REGISTRY
+    marks = {}
+    for name, snap in REGISTRY.snapshot().items():
+        if name.startswith('device.') and \
+                name.endswith('.live_bytes') and \
+                snap.get('type') == 'gauge':
+            peak = snap.get('max') or snap.get('value')
+            if peak:
+                dev = name[len('device.'):-len('.live_bytes')]
+                marks[dev] = max(marks.get(dev, 0), int(peak))
+    if trace and os.path.exists(trace):
+        try:
+            from .analyze import load_processes
+            procs, _ = load_processes(trace)
+        except Exception:
+            procs = {}
+        for records in procs.values():
+            for r in records:
+                name = r.get('name', '')
+                if r.get('t') == 'metric' and \
+                        name.startswith('device.') and \
+                        name.endswith('.live_bytes'):
+                    peak = r.get('max') or r.get('value') or 0
+                    if peak:
+                        dev = name[len('device.'):-len('.live_bytes')]
+                        marks[dev] = max(marks.get(dev, 0), int(peak))
+    return marks
+
+
 def _resilience_counts(trace):
     """Observed retry/degrade/resume/fault totals: live registry
     counters merged (per-key max, so a same-process doctor run does
@@ -257,7 +290,9 @@ def run_doctor(trace=None, root='.', self_check_only=False,
     has non-baselined findings, or TUNE_CACHE.json is malformed.
     WARN covers stale replays, regressions, compile-cache misses
     whose jit label carries an open NBK2xx finding (the
-    static/runtime cross-link), and tune-cache entries measured on a
+    static/runtime cross-link), device live-byte watermarks past half
+    a v5e's HBM while open NBK5xx (donation/peak) findings exist (the
+    same cross-link for memory), and tune-cache entries measured on a
     different platform/device kind than this host or older than 30
     days — loud, but not blocking.
     """
@@ -348,7 +383,7 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                      'under %s (pass the repo root as --root to lint)'
                      % root)
     elif root is not None:
-        open_nbk2, label_map = [], {}
+        open_nbk2, open_nbk5, label_map = [], [], {}
         try:
             new, open_findings, label_map = _lint_findings(root)
         except Exception as e:
@@ -357,6 +392,8 @@ def run_doctor(trace=None, root='.', self_check_only=False,
         else:
             open_nbk2 = [f for f in open_findings
                          if f.code.startswith('NBK2')]
+            open_nbk5 = [f for f in open_findings
+                         if f.code.startswith('NBK5')]
             ngrand = len(open_findings) - len(new)
             if new:
                 fail.append('lint')
@@ -382,6 +419,24 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                          'cache %dx — open %s at %s:%d: %s'
                          % (label, nmiss, f0.code, f0.path, f0.line,
                             f0.message))
+        # static/runtime cross-link #2 — the NBK2xx<->compile pattern
+        # for memory: a device whose live-bytes watermark crossed half
+        # of a v5e's HBM while the tree carries open NBK5xx
+        # (donation/peak) findings is the static hazard biting at
+        # runtime; print the finding next to the watermark
+        if open_nbk5:
+            for dev, peak in sorted(
+                    _device_watermark_bytes(trace).items()):
+                if peak < 0.5 * 16e9:
+                    continue
+                warn.append('memory')
+                f0 = open_nbk5[0]
+                lines.append(
+                    'memory       WARN: device %s live-bytes '
+                    'watermark %.2f GB with %d open NBK5xx '
+                    'finding(s) — e.g. %s at %s:%d: %s'
+                    % (dev, peak / 1e9, len(open_nbk5), f0.code,
+                       f0.path, f0.line, f0.message))
 
     if root is not None:
         # tuner posture: is the performance database trustworthy for

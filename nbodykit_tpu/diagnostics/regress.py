@@ -238,10 +238,12 @@ def load_caches(root, stale_hours=24.0, now=None):
 def lint_summary(root):
     """Current shard-safety lint counts for the round record: the
     committed ``lint_baseline.json`` is expected to *shrink* over PRs,
-    so the count is tracked in BENCH_HISTORY.json like a bench metric.
-    Returns None when ``root`` holds no lintable package; never raises
-    (a broken linter must not wedge the bench gate — the error string
-    is recorded instead)."""
+    so the count is tracked in BENCH_HISTORY.json like a bench metric
+    — and since PR 6 per rule FAMILY (NBK1xx collectives ...
+    NBK5xx memory/donation), so shrinkage in one family cannot mask
+    growth in another.  Returns None when ``root`` holds no lintable
+    package; never raises (a broken linter must not wedge the bench
+    gate — the error string is recorded instead)."""
     if not os.path.isdir(os.path.join(root, 'nbodykit_tpu')):
         return None
     try:
@@ -255,6 +257,7 @@ def lint_summary(root):
             'new': len(new),
             'baselined': len(grandfathered),
             'stale_baseline_entries': len(unused),
+            'families': lint_mod.family_stats(new, grandfathered),
             'baseline': os.path.basename(bl)
             if os.path.exists(bl) else None,
         }
@@ -407,8 +410,14 @@ def render_regress(history):
         if 'error' in lint:
             w('  lint: unavailable (%s)' % lint['error'])
         else:
-            w('  lint: %d finding(s) — %d new, %d baselined%s'
+            fams = lint.get('families') or {}
+            per_family = '  '.join(
+                '%s=%d+%d' % (k, v['new'], v['baselined'])
+                for k, v in sorted(fams.items())
+                if v['new'] or v['baselined'])
+            w('  lint: %d finding(s) — %d new, %d baselined%s%s'
               % (lint['findings'], lint['new'], lint['baselined'],
+                 ' (%s)' % per_family if per_family else '',
                  ', %d stale baseline entr%s to prune'
                  % (lint['stale_baseline_entries'],
                     'y' if lint['stale_baseline_entries'] == 1

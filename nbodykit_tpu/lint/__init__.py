@@ -5,18 +5,30 @@ nbodykit's correctness invariant — every rank executes the same
 collective program — carries over to the shard_map/psum substrate,
 where the failure modes are a hung fleet (rank-dependent collective),
 a recompile storm (jit cache busters), silent f32 demotion (TPU has no
-f64), and trace-time host ops frozen into the compiled program.  PR 2
-gave those *runtime* detection (diagnostics/analyze.py hung-collective
-tables, metrics.py ``xla.cache.*`` telemetry); this package is the
-*static* half: the same hazards caught at lint time, before anything
-runs.
+f64), trace-time host ops frozen into the compiled program, and
+full-mesh buffers XLA could have aliased but did not.  PR 2 gave
+those *runtime* detection (diagnostics/analyze.py hung-collective
+tables, metrics.py ``xla.cache.*`` telemetry, device watermarks);
+this package is the *static* half: the same hazards caught at lint
+time, before anything runs.
+
+Since v2 the linter is **interprocedural**: ``callgraph.py`` builds a
+project-wide call graph (cross-module, resolving the ``jax.jit`` /
+``instrumented_jit`` / ``shard_map`` / ``lru_cache``-builder wrapper
+idioms, including the lru-cached program-tuple unpacking in dfft.py),
+and two analysis families run on it — ``collectives.py`` enumerates
+per-path collective sequences (NBK103 deadlock detection) and
+``sizes.py`` tracks full-mesh-sized values through assignments and
+call boundaries with a donation-aware symbolic peak model (NBK5xx,
+``--memory-report``).
 
 Rule families (full catalog: ``nbodykit-tpu-lint --list-rules``,
 docs/LINT.md):
 
 =======  ==========================================================
 NBK1xx   collectives — axis_name/shard_map mismatches, rank-gated
-         collectives (the static form of the hung-collective bug)
+         collectives, divergent collective sequences across SPMD
+         paths (the static forms of the hung-collective bug)
 NBK2xx   compile hygiene — jit in loops, per-call jit of lambdas/
          closures, unhashable static args (the ``xla.cache.misses``
          storms)
@@ -24,22 +36,32 @@ NBK3xx   precision — float64 reaching jax unguarded, int32
          flattened-index overflow
 NBK4xx   trace safety — ``.item()``/``float()``/``np.asarray`` /
          ``time.time()``/``np.random.*`` inside traced code
+NBK5xx   memory/donation — mesh-sized jit arguments without
+         ``donate_argnums``, donations defeated by live caller
+         references, symbolic peaks over the ``memory_plan`` budget
 =======  ==========================================================
 
 Workflow: ``nbodykit-tpu-lint --baseline lint_baseline.json`` exits
 nonzero only on findings not grandfathered in the committed baseline;
-inline ``# nbkl: disable=NBKxxx`` suppresses a single audited site.
-The package is stdlib-only (pure AST — no project code is imported or
-executed).
+inline ``# nbkl: disable=NBKxxx`` suppresses a single audited site;
+``--stats`` emits the per-family JSON scripts/smoke.sh gates on, and
+``--memory-report --nmesh 1024`` prints the per-function symbolic
+peak table for a declared config.  The package is stdlib-only (pure
+AST — no project code is imported or executed; only the optional
+memory-report budget header consults ``pmesh.memory_plan``, lazily).
 """
 
 from .rules import RULES, Finding, run_rules  # noqa: F401
 from .scopes import ModuleContext  # noqa: F401
-from .walker import (canonical_path, collect_jit_labels,  # noqa: F401
-                     default_targets, iter_target_files, lint_paths,
-                     lint_source)
+from .callgraph import Project, single_project  # noqa: F401
+from .sizes import (MemoryConfig, make_config,  # noqa: F401
+                    memory_report, render_memory_report)
+from .walker import (build_project, canonical_path,  # noqa: F401
+                     collect_jit_labels, default_targets,
+                     iter_target_files, lint_paths, lint_source)
 from .baseline import (apply_baseline, build_baseline,  # noqa: F401
                        load_baseline, write_baseline)
-from .report import (family_of, render_findings,  # noqa: F401
-                     render_json, render_summary, summarize_findings)
-from .cli import main, run_lint  # noqa: F401
+from .report import (family_of, family_stats,  # noqa: F401
+                     render_findings, render_json, render_stats,
+                     render_summary, summarize_findings)
+from .cli import main, run_lint, run_memory_report  # noqa: F401

@@ -1,15 +1,25 @@
 """The ``nbodykit-tpu-lint`` command.
 
     nbodykit-tpu-lint                      # lint the default surface
-    nbodykit-tpu-lint nbodykit_tpu/ tests/_multihost_worker.py
+    nbodykit-tpu-lint nbodykit_tpu/ tests/_multihost_worker.py bench.py
     nbodykit-tpu-lint --baseline lint_baseline.json
     nbodykit-tpu-lint --write-baseline lint_baseline.json
-    nbodykit-tpu-lint --select NBK1,NBK4 --json
+    nbodykit-tpu-lint --select NBK1,NBK5 --json
+    nbodykit-tpu-lint --stats --baseline lint_baseline.json
+    nbodykit-tpu-lint --memory-report --nmesh 1024 bench.py
+    nbodykit-tpu-lint --nmesh 1024 --hbm-gb 16    # NBK503 gating
 
 Exit codes: 0 — no non-baselined findings; 1 — new findings (the CI
 gate); 2 — usage / IO error.  ``scripts/smoke.sh`` and
 ``tests/test_lint.py`` both run the baseline-gated form, so a new
-hazard cannot land silently.
+hazard cannot land silently; smoke also consumes the ``--stats``
+per-family JSON and runs a bounded ``--memory-report`` on the
+north-star 1024 cubed config.
+
+``--nmesh`` declares a memory config: NBK503 then gates functions
+whose symbolic peak exceeds the ``pmesh.memory_plan`` budget
+(0.85 x ``--hbm-gb``).  ``--memory-report`` prints the full
+per-function symbolic-peak table for that config instead of linting.
 """
 
 import argparse
@@ -18,9 +28,9 @@ import sys
 
 from . import baseline as baseline_mod
 from .report import (render_findings, render_json, render_rule_catalog,
-                     render_summary)
-from .walker import canonical_path, default_targets, iter_target_files, \
-    lint_paths
+                     render_stats, render_summary)
+from .walker import build_project, canonical_path, default_targets, \
+    iter_target_files, lint_paths
 
 
 def _sources_for(paths):
@@ -35,17 +45,45 @@ def _sources_for(paths):
     return out
 
 
-def run_lint(paths=None, baseline_path=None, select=None):
+def run_lint(paths=None, baseline_path=None, select=None,
+             memory_config=None):
     """Programmatic form of the CLI (used by the doctor, regress.py and
     tests): returns ``(new, grandfathered, unused_entries)``."""
     paths = list(paths) if paths else default_targets()
-    findings = lint_paths(paths, select=select)
+    findings = lint_paths(paths, select=select,
+                          memory_config=memory_config)
     if baseline_path:
         base = baseline_mod.load_baseline(baseline_path)
     else:
         base = {}
     sources = _sources_for(paths)
     return baseline_mod.apply_baseline(findings, base, sources=sources)
+
+
+def _memory_config_from(args):
+    """The declared config, or None when ``--nmesh`` was not given."""
+    if args.nmesh is None:
+        return None
+    from .sizes import make_config
+    import re
+    m = re.search(r'(\d+)', args.dtype or 'f4')
+    dtype_bytes = int(m.group(1)) if m else 4
+    return make_config(args.nmesh, dtype_bytes=dtype_bytes,
+                       hbm_bytes=args.hbm_gb * 1e9)
+
+
+def run_memory_report(paths, config, npart=None, out=None):
+    """--memory-report: the per-function symbolic peak table."""
+    from .sizes import memory_report, render_memory_report
+    out = out if out is not None else sys.stdout
+    project, parse_findings = build_project(
+        paths, memory_config=config)
+    for f in parse_findings:
+        print('nbodykit-tpu-lint: %s: %s' % (f.path, f.message),
+              file=sys.stderr)
+    report = memory_report(project, config, npart=npart)
+    out.write(render_memory_report(report))
+    return report
 
 
 def main(argv=None):
@@ -56,7 +94,7 @@ def main(argv=None):
     ap.add_argument('paths', nargs='*',
                     help='files/directories to lint (default: the '
                          'nbodykit_tpu package + '
-                         'tests/_multihost_worker.py)')
+                         'tests/_multihost_worker.py + bench.py)')
     ap.add_argument('--baseline', metavar='FILE', default=None,
                     help='grandfathered findings; only findings NOT in '
                          'it fail the run')
@@ -68,10 +106,30 @@ def main(argv=None):
                          '(e.g. NBK1,NBK402)')
     ap.add_argument('--json', action='store_true',
                     help='machine-readable output')
+    ap.add_argument('--stats', action='store_true',
+                    help='emit per-family new/baselined counts as '
+                         'JSON (the smoke-gate format); same exit '
+                         'contract as the plain gate')
     ap.add_argument('--no-hints', action='store_true',
                     help='omit the fix-hint lines')
     ap.add_argument('--list-rules', action='store_true',
                     help='print the rule catalog and exit')
+    ap.add_argument('--memory-report', action='store_true',
+                    help='print the per-function symbolic peak table '
+                         'for the declared config (requires --nmesh) '
+                         'instead of linting')
+    ap.add_argument('--nmesh', type=int, default=None,
+                    help='declare a mesh config: enables NBK503 '
+                         'budget gating and --memory-report')
+    ap.add_argument('--dtype', default='f4',
+                    help='mesh dtype for the declared config '
+                         '(default f4)')
+    ap.add_argument('--hbm-gb', type=float, default=16.0,
+                    help='per-device HBM for the budget (default 16, '
+                         'the v5e chip)')
+    ap.add_argument('--npart', type=float, default=None,
+                    help='particle count forwarded to '
+                         'pmesh.memory_plan for the report header')
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -87,7 +145,16 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
 
-    findings = lint_paths(paths, select=select)
+    config = _memory_config_from(args)
+    if args.memory_report:
+        if config is None:
+            print('nbodykit-tpu-lint: --memory-report requires '
+                  '--nmesh', file=sys.stderr)
+            return 2
+        run_memory_report(paths, config, npart=args.npart)
+        return 0
+
+    findings = lint_paths(paths, select=select, memory_config=config)
     sources = _sources_for(paths)
 
     if args.write_baseline:
@@ -108,7 +175,10 @@ def main(argv=None):
     new, grandfathered, unused = baseline_mod.apply_baseline(
         findings, base, sources=sources)
 
-    if args.json:
+    if args.stats:
+        sys.stdout.write(render_stats(new, grandfathered, unused,
+                                      baseline_path=args.baseline))
+    elif args.json:
         sys.stdout.write(render_json(new, grandfathered, unused))
     else:
         sys.stdout.write(render_findings(

@@ -1,0 +1,422 @@
+"""NBK103 — interprocedural collective-order deadlock detection.
+
+The SPMD contract behind every hang `diagnostics/analyze.py` has ever
+post-mortemed is *sequence* equality: each rank must execute the SAME
+collectives in the SAME order.  NBK102 catches the textbook violation
+(a collective under a rank-gated branch, same module); this analysis
+is the general, interprocedural form:
+
+1. every function in the project is summarized by the **set of
+   collective sequences** its paths can emit — ``psum``/``all_to_all``
+   /``pshuffle``/``all_gather``/... tokens in execution order, with
+   callee summaries spliced in at call sites (fixpoint over the
+   :class:`~nbodykit_tpu.lint.callgraph.Project` call graph, bounded
+   to keep path explosion finite);
+2. a branch whose test is **rank-derived** (``jax.process_index()``
+   taint) or **traced-data-derived** (parameter taint inside a traced
+   function) and whose two arms emit *different* collective sequences
+   is flagged: ranks taking different arms emit different programs and
+   the fleet deadlocks at the first mismatch;
+3. a branch where one arm **exits early** (``return``/``raise``) while
+   collectives still follow on the fall-through path is flagged the
+   same way — the exiting rank leaves its peers blocked in the next
+   collective.  Independently of the test's taint, any *conditional*
+   ``raise``/``return`` sitting strictly **between** two collectives
+   of a collective-emitting function is flagged as an exception-path
+   divergence: an error raised on one rank (bad data, a failed
+   validation) after collective *i* but before collective *i+1* hangs
+   every other rank in *i+1* — the static form of the torn-fleet
+   post-mortems in docs/OBSERVABILITY.md.
+
+Bounds: at most :data:`MAX_SEQS` distinct sequences of at most
+:data:`MAX_LEN` tokens are tracked per function; past either bound the
+summary degrades to "varied" and the comparisons stay silent rather
+than guessing (a linter must prefer a false negative to a false
+alarm).
+"""
+
+import ast
+
+from .scopes import COLLECTIVE_TAILS
+
+# tokens beyond jax.lax collectives: the explicit host-level barriers
+# used by the multi-host worker and jax.experimental.multihost_utils
+_EXTRA_COLLECTIVE_TAILS = frozenset({
+    'barrier', 'sync_global_devices', 'broadcast_one_to_all'})
+# axis_index only reads the coordinate — it does not synchronize
+SEQ_TAILS = (frozenset(COLLECTIVE_TAILS) - {'axis_index'}) \
+    | _EXTRA_COLLECTIVE_TAILS
+
+MAX_SEQS = 16
+MAX_LEN = 32
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: summary sentinel: too many paths / too long to track faithfully
+VARIED = None
+
+
+def _cap(pairs):
+    """Apply the MAX_SEQS/MAX_LEN bounds; VARIED when exceeded."""
+    if pairs is VARIED or len(pairs) > MAX_SEQS:
+        return VARIED
+    if any(len(s) > MAX_LEN for (s, _t) in pairs):
+        return VARIED
+    return pairs
+
+
+def _collective_tail(ctx, call):
+    q = ctx.call_name(call)
+    if q is None:
+        return None
+    tail = q.rsplit('.', 1)[-1]
+    return tail if tail in SEQ_TAILS else None
+
+
+class _Analysis(object):
+    """One fixpoint over the project: function node -> summary.
+
+    A summary is a frozenset of collective-token tuples (the possible
+    per-path sequences), or VARIED.  Findings are collected in a
+    second pass, once summaries are stable.
+    """
+
+    def __init__(self, project):
+        self.project = project
+        self.summaries = {}     # id(fn) -> frozenset of tuples | VARIED
+        self._run_fixpoint()
+
+    # -- summaries ---------------------------------------------------------
+
+    def summary_of(self, fn):
+        return self.summaries.get(id(fn), frozenset({()}))
+
+    def _run_fixpoint(self):
+        funcs = list(self.project.functions())
+        for _ in range(10):
+            changed = False
+            for ctx, fn in funcs:
+                body = fn.body if not isinstance(fn, ast.Lambda) \
+                    else [ast.Expr(value=fn.body)]
+                paths = _cap(self._walk(ctx, fn, body))
+                new = VARIED if paths is VARIED else \
+                    frozenset(s for (s, _t) in paths)
+                if new != self.summaries.get(id(fn), frozenset({()})):
+                    self.summaries[id(fn)] = new
+                    changed = True
+            if not changed:
+                break
+
+    # -- path walking ------------------------------------------------------
+
+    def _walk(self, ctx, fn, stmts, findings=None, taints=None):
+        """All (sequence, terminated) pairs the statement list can
+        produce, VARIED past the bounds.  When ``findings`` is a list,
+        divergences are appended as (node, kind, detail)."""
+        results = {((), False)}
+        for i, stmt in enumerate(stmts):
+            effects = self._stmt_effect(ctx, fn, stmt, stmts[i + 1:],
+                                        findings, taints)
+            if effects is VARIED or results is VARIED:
+                return VARIED
+            new = set()
+            for seq, term in results:
+                if term:
+                    new.add((seq, True))
+                    continue
+                for s2, t2 in effects:
+                    if len(seq) + len(s2) > MAX_LEN:
+                        return VARIED
+                    new.add((seq + s2, t2))
+            results = new
+            if len(results) > MAX_SEQS:
+                return VARIED
+        return results
+
+    def _expr_seq(self, ctx, fn, expr):
+        """Possible collective sequences of evaluating an expression
+        (source order), splicing in resolved callee summaries."""
+        seqs = {()}
+        if expr is None:
+            return seqs
+        for node in _source_order(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            tok = _collective_tail(ctx, node)
+            if tok is not None:
+                seqs = _append_all(seqs, {(tok,)})
+            else:
+                tgt = self.project.resolve_call(ctx, node)
+                if tgt is None or tgt.ref is None or \
+                        tgt.ref.node is fn:
+                    continue    # unresolved / direct recursion: cut
+                sub = self.summary_of(tgt.ref.node)
+                if sub is VARIED:
+                    return VARIED
+                if sub != frozenset({()}):
+                    seqs = _append_all(seqs, sub)
+            if seqs is VARIED or len(seqs) > MAX_SEQS:
+                return VARIED
+        return seqs
+
+    def _stmt_effect(self, ctx, fn, stmt, rest, findings, taints):
+        """(sequence, terminated) pairs of one statement."""
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            val = stmt.value if isinstance(stmt, ast.Return) \
+                else getattr(stmt, 'exc', None)
+            seqs = self._expr_seq(ctx, fn, val)
+            if seqs is VARIED:
+                return VARIED
+            return {(s, True) for s in seqs}
+        if isinstance(stmt, ast.If):
+            return self._if_effect(ctx, fn, stmt, rest, findings,
+                                   taints)
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            head = self._expr_seq(
+                ctx, fn, stmt.iter if hasattr(stmt, 'iter')
+                else stmt.test)
+            body = self._walk(ctx, fn, stmt.body, findings, taints)
+            if head is VARIED or body is VARIED:
+                return VARIED
+            # body executed once stands in for n iterations: sequence
+            # *content* divergence inside still surfaces, trip-count
+            # divergence is out of scope
+            out = set()
+            for h in head:
+                for s, t in body:
+                    out.add((h + s, t))
+                out.add((h, False))     # zero-iteration path
+            return _capped_pairs(out)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = {()}
+            for item in stmt.items:
+                head = _append_all(head, self._expr_seq(
+                    ctx, fn, item.context_expr))
+                if head is VARIED:
+                    return VARIED
+            body = self._walk(ctx, fn, stmt.body, findings, taints)
+            if body is VARIED:
+                return VARIED
+            return _capped_pairs({(h + s, t) for h in head
+                                  for s, t in body})
+        if isinstance(stmt, ast.Try):
+            body = self._walk(ctx, fn, stmt.body, findings, taints)
+            if body is VARIED:
+                return VARIED
+            out = set(body)
+            for h in stmt.handlers:
+                hb = self._walk(ctx, fn, h.body, findings, taints)
+                if hb is VARIED:
+                    return VARIED
+                out |= hb
+            if stmt.finalbody:
+                fin = self._walk(ctx, fn, stmt.finalbody, findings,
+                                 taints)
+                if fin is VARIED:
+                    return VARIED
+                out = {(s + f, t or tf) for s, t in out
+                       for f, tf in fin}
+            return _capped_pairs(out)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return {((), False)}        # a def emits nothing itself
+        # plain statement: every expression it evaluates
+        seqs = {()}
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                seqs = _append_all(seqs, self._expr_seq(ctx, fn, sub))
+                if seqs is VARIED:
+                    return VARIED
+        return {(s, False) for s in seqs}
+
+    # -- divergence detection ----------------------------------------------
+
+    def _classify_test(self, ctx, fn, test, taints):
+        """'rank' / 'data' / None for a branch condition."""
+        rank, data = taints
+        if ctx.expr_rank_derived(test, rank):
+            return 'rank'
+        if data and any(isinstance(s, ast.Name) and s.id in data
+                        and isinstance(s.ctx, ast.Load)
+                        for s in ast.walk(test)):
+            return 'data'
+        return None
+
+    def _if_effect(self, ctx, fn, stmt, rest, findings, taints):
+        head = self._expr_seq(ctx, fn, stmt.test)
+        body = self._walk(ctx, fn, stmt.body, findings, taints)
+        orelse = self._walk(ctx, fn, stmt.orelse, findings, taints)
+        if VARIED in (head, body, orelse):
+            return VARIED
+        if findings is not None and taints is not None:
+            kind = self._classify_test(ctx, fn, stmt.test, taints)
+            if kind is not None:
+                emits_a = frozenset(s for s, _t in body)
+                emits_b = frozenset(s for s, _t in orelse)
+                if emits_a != emits_b:
+                    findings.append((stmt, kind,
+                                     _describe(emits_a, emits_b)))
+                elif any(t for _s, t in body) != \
+                        any(t for _s, t in orelse) and \
+                        self._rest_has_collectives(ctx, fn, rest):
+                    findings.append((
+                        stmt, kind,
+                        'one arm exits early while collectives still '
+                        'follow on the fall-through path'))
+        out = set()
+        for h in head:
+            for s, t in body | orelse:
+                out.add((h + s, t))
+        return _capped_pairs(out)
+
+    def _definite_collective_call(self, ctx, node):
+        """Does this call definitely execute collectives?  VARIED
+        callee summaries count as unknown, i.e. NO — the linter
+        prefers a false negative to flagging host orchestration code
+        whose callees merely exploded the path bound."""
+        if _collective_tail(ctx, node) is not None:
+            return True
+        tgt = self.project.resolve_call(ctx, node)
+        if tgt is not None and tgt.ref is not None:
+            sub = self.summary_of(tgt.ref.node)
+            return sub is not VARIED and sub != frozenset({()})
+        return False
+
+    def _rest_has_collectives(self, ctx, fn, rest):
+        for stmt in rest:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        self._definite_collective_call(ctx, node):
+                    return True
+        return False
+
+    # -- the reporting pass ------------------------------------------------
+
+    def divergences(self, ctx):
+        """(node, kind, detail) triples for one module, computed with
+        the stable project summaries."""
+        out = []
+        for fn in ctx.functions:
+            summ = self.summary_of(fn)
+            emits = summ is VARIED or summ != frozenset({()})
+            if not emits:
+                continue
+            rank = ctx.rank_tainted_names(fn)
+            data = ctx.param_tainted_names(fn) \
+                if ctx.is_traced(fn) else set()
+            body = fn.body if not isinstance(fn, ast.Lambda) \
+                else [ast.Expr(value=fn.body)]
+            found = []
+            self._walk(ctx, fn, body, findings=found,
+                       taints=(rank, data))
+            out.extend(found)
+            out.extend(self._exception_paths(ctx, fn))
+        return out
+
+    def _exception_paths(self, ctx, fn):
+        """Conditional raise/return strictly between two collectives
+        of this function (line order): the exiting rank strands its
+        peers in the next collective."""
+        lines = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or \
+                    ctx.enclosing_function(node) is not fn:
+                continue
+            if self._definite_collective_call(ctx, node):
+                lines.append(node.lineno)
+        if len(lines) < 2:
+            return []
+        first, last = min(lines), max(lines)
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Return, ast.Raise)):
+                continue
+            if ctx.enclosing_function(node) is not fn:
+                continue
+            if not (first < node.lineno < last):
+                continue
+            if not self._conditional(ctx, node, fn):
+                continue
+            out.append((
+                node, 'exception-path',
+                '%s between collectives (first at line %d, more '
+                'follow at line %d): a rank leaving here strands its '
+                'peers in the next collective'
+                % ('raise' if isinstance(node, ast.Raise)
+                   else 'early return', first, last)))
+        return out
+
+    def _conditional(self, ctx, node, fn):
+        n = ctx.parents.get(node)
+        while n is not None and n is not fn:
+            if isinstance(n, (ast.If, ast.IfExp)):
+                return True
+            if isinstance(n, ast.Try):
+                return True
+            n = ctx.parents.get(n)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+def _source_order(node):
+    """Call nodes of an expression in evaluation order: arguments
+    before the call that consumes them (post-order), siblings left to
+    right — so ``psum(all_gather(x, ax), ax)`` yields the all_gather
+    first."""
+    out = []
+
+    def visit(n):
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+        if isinstance(n, ast.Call):
+            out.append(n)
+
+    visit(node)
+    return out
+
+
+def _append_all(seqs, tails):
+    if seqs is VARIED or tails is VARIED:
+        return VARIED
+    out = {s + t for s in seqs for t in tails}
+    return VARIED if len(out) > MAX_SEQS else out
+
+
+def _capped_pairs(pairs):
+    if len(pairs) > MAX_SEQS:
+        return VARIED
+    if any(len(s) > MAX_LEN for s, _t in pairs):
+        return VARIED
+    return pairs
+
+
+def _fmt_seq(seq):
+    return '(' + ' -> '.join(seq) + ')' if seq else '(none)'
+
+
+def _describe(emits_a, emits_b):
+    a = sorted(emits_a, key=len, reverse=True)
+    b = sorted(emits_b, key=len, reverse=True)
+    return ('true-arm emits %s, false-arm emits %s'
+            % (_fmt_seq(a[0]) if a else '(none)',
+               _fmt_seq(b[0]) if b else '(none)'))
+
+
+def analysis_for(project):
+    """The (cached) project-wide analysis."""
+    cached = getattr(project, '_coll_analysis', None)
+    if cached is None:
+        cached = _Analysis(project)
+        project._coll_analysis = cached
+    return cached
+
+
+def find_divergences(ctx):
+    """NBK103 raw findings for one module: (node, kind, detail)."""
+    from .callgraph import single_project
+    project = getattr(ctx, 'project', None)
+    if project is None:
+        project = single_project(ctx)
+    return analysis_for(project).divergences(ctx)

@@ -11,6 +11,7 @@ FAMILIES = collections.OrderedDict([
     ('NBK2', 'compile hygiene'),
     ('NBK3', 'precision'),
     ('NBK4', 'trace safety'),
+    ('NBK5', 'memory/donation'),
     ('NBK0', 'tool'),
 ])
 
@@ -68,6 +69,42 @@ def render_summary(new, grandfathered, unused, baseline_path=None):
                          % (e.get('code'), e.get('path'),
                             (e.get('line_text') or '')[:48]))
     return '\n'.join(lines) + '\n'
+
+
+def family_stats(new, grandfathered):
+    """Per-family new/baselined counts — the machine-readable shape
+    ``--stats`` emits and regress.py records in BENCH_HISTORY.json,
+    so baseline shrinkage is tracked per family, not just in
+    aggregate."""
+    fams = {}
+    for prefix in FAMILIES:
+        fams[prefix] = {'new': 0, 'baselined': 0}
+    for f in new:
+        fams.setdefault(f.code[:4], {'new': 0, 'baselined': 0})
+        fams[f.code[:4]]['new'] += 1
+    for f in grandfathered:
+        fams.setdefault(f.code[:4], {'new': 0, 'baselined': 0})
+        fams[f.code[:4]]['baselined'] += 1
+    return {k: v for k, v in fams.items()
+            if v['new'] or v['baselined'] or k != 'NBK0'}
+
+
+def render_stats(new, grandfathered, unused, baseline_path=None):
+    """The ``--stats`` JSON document: per-family and per-code counts
+    plus the gate verdict, consumed by scripts/smoke.sh."""
+    fams = family_stats(new, grandfathered)
+    return json.dumps({
+        'families': {k: dict(v, label=FAMILIES.get(k, 'other'))
+                     for k, v in sorted(fams.items())},
+        'by_code': {
+            'new': summarize_findings(new)['by_code'],
+            'baselined': summarize_findings(grandfathered)['by_code'],
+        },
+        'total': {'new': len(new), 'baselined': len(grandfathered),
+                  'stale_baseline_entries': len(unused)},
+        'baseline': baseline_path,
+        'gate': 'FAIL' if new else 'OK',
+    }, indent=1, sort_keys=True) + '\n'
 
 
 def render_json(new, grandfathered, unused):

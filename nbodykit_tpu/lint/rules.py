@@ -100,6 +100,41 @@ def collective_axis_mismatch(ctx):
             'shared AXIS constant, parallel/runtime.py style)')
 
 
+@rule('NBK103', 'divergent collective sequences across SPMD paths')
+def collective_order_divergence(ctx):
+    """The general, interprocedural form of the hung-collective bug:
+    every rank must emit the SAME collectives in the SAME order, so a
+    branch on a rank-derived or traced-data condition whose arms emit
+    different collective sequences — or a conditional raise / early
+    return sitting between two collectives — deadlocks the fleet at
+    the first mismatch.  Sequences are enumerated per path with callee
+    summaries spliced in (collectives.py), so the divergence is caught
+    across helper and module boundaries where NBK102's same-module
+    reachability stops."""
+    from .collectives import find_divergences
+    for node, kind, detail in find_divergences(ctx):
+        if kind == 'rank':
+            msg = ('collective sequences diverge on a rank-derived '
+                   'branch: %s — ranks taking different arms '
+                   'deadlock at the first mismatch' % detail)
+            hint = ('make rank-dependent work data-dependent '
+                    '(mask/weight) or hoist the collectives so every '
+                    'rank emits the same sequence')
+        elif kind == 'data':
+            msg = ('collective sequences diverge on a traced-data '
+                   'branch: %s — data that differs per rank '
+                   'desynchronizes the collective program' % detail)
+            hint = ('branch on static configuration, or emit the '
+                    'same collective sequence on every arm '
+                    '(lax.cond with matching collectives)')
+        else:   # exception-path
+            msg = 'divergent exception path: %s' % detail
+            hint = ('validate before the first collective, or turn '
+                    'the failure into data every rank reduces '
+                    '(psum an error flag) so all ranks exit together')
+        yield _finding('NBK103', ctx, node, msg, hint)
+
+
 @rule('NBK102', 'collective under a rank-dependent branch')
 def rank_gated_collective(ctx):
     """A collective executed only when ``jax.process_index() == 0``
@@ -576,3 +611,81 @@ def impure_host_op_in_trace(ctx):
                 'use jax.random with an explicit key (rng.py), or '
                 'compute host values before entering the traced '
                 'function')
+
+
+# ---------------------------------------------------------------------------
+# NBK5xx — static HBM / donation analysis (sizes.py)
+
+
+@rule('NBK501', 'mesh-sized argument consumed by a jit call without '
+                'donate_argnums')
+def undonated_mesh_arg(ctx):
+    """A full-mesh value (4 GB at 1024 cubed in f4) passed to a jitted
+    program and never read again is a buffer XLA could alias in place
+    — but only if the call site says ``donate_argnums``.  Without it
+    the program holds input AND output at peak: the avoidable stage
+    buffer of ROADMAP #4.  Only fires when the value is provably dead
+    after the call, so adding the donation is always sound."""
+    from .sizes import find_undonated
+    for call, name, pos in find_undonated(ctx):
+        yield _finding(
+            'NBK501', ctx, call,
+            'jit call consumes mesh-sized %r (argument %d) without '
+            'donate_argnums — input and output both live at peak, '
+            'one avoidable full-mesh buffer' % (name, pos),
+            'declare donate_argnums=(%d,) on the jit/instrumented_jit '
+            'construction; %r is not read after this call, so XLA '
+            'will alias the buffer in place' % (pos, name))
+
+
+@rule('NBK502', 'donated mesh-sized buffer still referenced by the '
+                'caller')
+def held_donation(ctx):
+    """Donation only aliases when the donated buffer has no other
+    owner.  A mesh-sized argument donated while the caller still
+    reads it afterwards (or on the next loop iteration) silently
+    defeats the aliasing — jax warns 'donated buffer was not usable'
+    at runtime, the program holds an extra full-mesh buffer, and at
+    1024 cubed that is the 4 GB between fitting v5e HBM and OOM.
+    This is the static form of that runtime warning."""
+    from .sizes import find_held_donations
+    for call, name, pos in find_held_donations(ctx):
+        yield _finding(
+            'NBK502', ctx, call,
+            'mesh-sized %r donated (argument %d) but read again '
+            'after the call — the caller\'s live reference defeats '
+            'the aliasing, costing a full extra mesh buffer at peak'
+            % (name, pos),
+            'drop the reference before the call (del it, rebind to '
+            'None — the dfft.py lowmem pattern — or hand over a '
+            'one-element list) so the donation actually aliases')
+
+
+@rule('NBK503', 'symbolic peak exceeds the memory_plan budget for '
+                'the declared config')
+def over_memory_budget(ctx):
+    """With a declared config (``--nmesh``/``--memory-report``), a
+    function whose chain of mesh-sized values peaks over the
+    ``pmesh.memory_plan`` budget (0.85 x HBM, the plan's allocator
+    margin) is flagged before any chip is allocated.  Silent without
+    a config — symbolic units only become bytes once nmesh and dtype
+    are declared."""
+    from .sizes import find_over_budget, unit_bytes
+    project = getattr(ctx, 'project', None)
+    config = getattr(project, 'memory_config', None) \
+        if project is not None else None
+    if config is None:
+        return
+    for fn, name, peak, peak_bytes in find_over_budget(ctx):
+        yield _finding(
+            'NBK503', ctx, fn,
+            '%s() holds %.1f full-mesh units at peak = %.2f GB at '
+            'nmesh=%d (%d-byte dtype) — over the %.2f GB '
+            'memory_plan budget'
+            % (name, peak, peak_bytes / 1e9, config.nmesh,
+               config.dtype_bytes, config.budget_bytes / 1e9),
+            'donate the inter-stage buffers (NBK501/NBK502), split '
+            'the chain into separate donated programs (bench.py '
+            'staged-ladder pattern), or chunk the stage; '
+            '--memory-report prints the full per-function table '
+            '(unit = %.2f GB)' % (unit_bytes(config) / 1e9))

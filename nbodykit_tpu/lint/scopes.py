@@ -116,6 +116,7 @@ class ModuleContext(object):
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
                 self.parents[child] = node
+        self._scope_memo = {}       # id(node) -> enclosing scope
         self.aliases = {}       # local name -> canonical dotted prefix
         self._collect_imports()
         self.constants = collect_module_constants(self.tree)
@@ -180,11 +181,19 @@ class ModuleContext(object):
     # -- scopes ------------------------------------------------------------
 
     def enclosing_scope(self, node):
-        """The innermost FunctionDef/Lambda/Module *containing* node."""
+        """The innermost FunctionDef/Lambda/Module *containing* node.
+        Memoized — the interprocedural passes (callgraph/sizes/
+        collectives) query this for nearly every node, repeatedly."""
+        key = id(node)
+        hit = self._scope_memo.get(key)
+        if hit is not None:
+            return hit
         n = self.parents.get(node)
         while n is not None and not isinstance(n, _SCOPE_NODES):
             n = self.parents.get(n)
-        return n if n is not None else self.tree
+        out = n if n is not None else self.tree
+        self._scope_memo[key] = out
+        return out
 
     def scope_chain(self, node):
         """Enclosing scopes innermost-first, ending at the Module."""

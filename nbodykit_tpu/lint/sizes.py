@@ -1,0 +1,746 @@
+"""NBK5xx — static HBM / donation analysis.
+
+The failure class this targets is the one that actually costs hardware
+windows (ROADMAP #4): a full-mesh buffer (4 GB at 1024 cubed in f4)
+that XLA *could* have aliased in place but did not, because the call
+site never declared ``donate_argnums`` — or declared it while the
+caller still held a live reference, which makes the donation
+unusable.  Both are invisible until a chip OOMs; both are statically
+decidable from the source.
+
+**The value model.**  A value is *mesh-sized* when it derives from a
+full-mesh producer:
+
+- project producers by name — ``paint`` / ``r2c`` / ``c2r`` /
+  ``dist_rfftn`` / ``*_single_lowmem`` / ``generate_whitenoise`` and
+  kin (:data:`PRODUCER_TAILS`), including the ``phase_fns['paint']``
+  dict-dispatch spelling;
+- allocations whose shape expression mentions a mesh token
+  (``Nmesh`` / ``shape_real`` / ``N0,N1,Nc``-style axis names, or
+  ``x.shape`` of a value already known mesh-sized);
+- interprocedurally, calls to functions whose *return* is mesh-sized
+  — summaries run to fixpoint over the
+  :class:`~nbodykit_tpu.lint.callgraph.Project` call graph, so a
+  jit-wrapped lambda returning a painted field taints its call sites
+  in other functions and other modules.
+
+Taint propagates through elementwise arithmetic, ``astype`` /
+``transpose`` / ``where``-class calls and the one-element-list
+"ownership box" idiom; it dies at reductions (``sum`` / ``item`` /
+histogramming) and at subscripts (slab slices are chunk-sized by
+construction).
+
+**The peak model** (``--memory-report``).  Per function, every
+mesh-sized local has a live interval (first producing assignment to
+last read / ``del``); nested producer calls add transient units; a
+donated consumption whose argument dies at the call is *aliased* (the
+result reuses the buffer — no new unit); resolved callees add their
+own symbolic peak beyond the one unit of their result.  The symbolic
+peak is the maximum number of simultaneously-live full-mesh units,
+reported as bytes for a declared config (``nmesh**3 * dtype``) and
+compared against the same 15%-margin budget
+``pmesh.memory_plan`` applies (NBK503).  It is a *unit count*, not an
+allocator simulation: its job is to make "this stage chain holds four
+mesh buffers where two suffice" visible on a laptop, pre-hardware.
+
+Rules
+-----
+NBK501  jit call consuming a dead mesh-sized argument without
+        ``donate_argnums`` — a missed alias, one avoidable full-mesh
+        buffer.
+NBK502  mesh-sized argument donated while the caller still reads it
+        afterwards (or on the next loop iteration) — XLA cannot alias
+        a buffer the caller holds; the static form of jax's "donated
+        buffer was not usable" runtime warning.
+NBK503  function whose symbolic peak exceeds the memory budget for
+        the declared config (only with a config: the CLI's
+        ``--nmesh`` / ``--memory-report``).
+
+Everything is stdlib-only; ``pmesh.memory_plan`` is only consulted —
+lazily, optionally — by :func:`memory_budget` for the report header.
+"""
+
+import ast
+import collections
+import re
+
+# -- classification tables ---------------------------------------------------
+
+#: call tails whose result is a full-mesh field by construction
+PRODUCER_TAILS = frozenset({
+    'paint', 'r2c', 'c2r',
+    'dist_rfftn', 'dist_irfftn', 'dist_fftn_c2c',
+    'rfftn_single_lowmem', 'irfftn_single_lowmem',
+    'fftn_c2c_single_lowmem',
+    'generate_whitenoise', 'to_real_field', 'to_complex_field',
+    'rfftn', 'irfftn', 'fftn', 'ifftn',
+})
+
+#: producers that take OWNERSHIP of their (boxed) input — the
+#: one-element-list contract of the dfft lowmem drivers: the argument
+#: buffer is freed (or becomes the callee's working buffer) at the
+#: call, so it aliases rather than stacking a new unit
+OWNERSHIP_TAILS = frozenset({
+    'rfftn_single_lowmem', 'irfftn_single_lowmem',
+    'fftn_c2c_single_lowmem'})
+
+#: internal symbolic peaks of producers we cannot (or choose not to)
+#: resolve — the documented buffer contracts (dfft.py docstrings)
+_PRODUCER_INTERNAL = {
+    'rfftn_single_lowmem': 2.0, 'irfftn_single_lowmem': 2.0,
+    'fftn_c2c_single_lowmem': 2.0,
+    'dist_rfftn': 3.0, 'dist_irfftn': 3.0, 'dist_fftn_c2c': 3.0,
+    'rfftn': 2.0, 'irfftn': 2.0, 'fftn': 2.0, 'ifftn': 2.0,
+    'r2c': 3.0, 'c2r': 3.0,
+}
+
+#: allocation tails that are mesh-sized when their shape says so
+ALLOC_TAILS = frozenset({'zeros', 'empty', 'ones', 'full', 'normal'})
+ALLOC_LIKE_TAILS = frozenset({
+    'zeros_like', 'empty_like', 'ones_like', 'full_like'})
+
+#: method / function tails that REDUCE away the mesh extent
+REDUCER_TAILS = frozenset({
+    'sum', 'mean', 'max', 'min', 'prod', 'any', 'all', 'item',
+    'tolist', 'len', 'count_nonzero', 'argmax', 'argmin', 'trace',
+    'histogram', 'histogramdd', 'bincount', 'dot', 'vdot', 'norm',
+    'block_until_ready', 'shape', 'size',
+    # slab/chunk extraction: the result is chunk-sized by construction
+    'dynamic_slice', 'take', 'take_along_axis',
+})
+
+#: identifier shapes that denote a full-mesh extent
+_MESH_TOKEN_RE = re.compile(
+    r'(?i)^(n?mesh\w*|shape_real|shape_complex|ntot|ncells?)$')
+_AXIS_NAME_RE = re.compile(r'^N[0-9c]$')
+
+#: ``returns``: 'no' | 'yes' (mesh-sized regardless of arguments);
+#: ``ret_params``: parameter names whose value flows into the return —
+#: the call result is mesh-sized iff the argument bound to one of them
+#: is (labeled taint, so ``_time_fn(jax, fn, (field,), reps)``
+#: returning wall-clock floats does NOT inherit the field's size)
+MemSummary = collections.namedtuple(
+    'MemSummary', ['returns', 'ret_params', 'peak'])
+
+MemoryConfig = collections.namedtuple(
+    'MemoryConfig', ['nmesh', 'dtype_bytes', 'hbm_bytes',
+                     'budget_bytes'])
+
+
+def make_config(nmesh, dtype_bytes=4, hbm_bytes=16e9,
+                budget_bytes=None):
+    """A declared config for NBK503 / the memory report.  The default
+    budget is the same 15% allocator margin ``pmesh.memory_plan``
+    applies to its ``fits`` verdict."""
+    if budget_bytes is None:
+        budget_bytes = 0.85 * hbm_bytes
+    return MemoryConfig(int(nmesh), int(dtype_bytes),
+                        float(hbm_bytes), float(budget_bytes))
+
+
+def unit_bytes(config):
+    """Bytes of one full-mesh unit for a config."""
+    return float(config.nmesh) ** 3 * config.dtype_bytes
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _tail(name):
+    return name.rsplit('.', 1)[-1] if name else None
+
+
+def _call_tail(ctx, call):
+    """Effective tail name of a call: dotted-name tail, the constant
+    key of a ``phase_fns['paint']`` dict dispatch, or the unwrapped
+    target of an immediately-invoked jit wrapper."""
+    q = ctx.call_name(call)
+    if q is not None:
+        return _tail(q)
+    func = call.func
+    if isinstance(func, ast.Subscript) and \
+            isinstance(func.slice, ast.Constant) and \
+            isinstance(func.slice.value, str):
+        return func.slice.value
+    if isinstance(func, ast.Call):
+        project = getattr(ctx, 'project', None)
+        if project is not None:
+            unwrapped = project._unwrap(ctx, func)
+            if unwrapped is not None:
+                target = unwrapped[0]
+                if isinstance(target, ast.Subscript) and \
+                        isinstance(target.slice, ast.Constant) and \
+                        isinstance(target.slice.value, str):
+                    return target.slice.value
+                tq = ctx.qual(target)
+                if tq is not None:
+                    return _tail(tq)
+    return None
+
+
+def _mesh_shape_like(ctx, expr, mesh_names):
+    """Does a shape expression denote a full-mesh extent?"""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name):
+            if _MESH_TOKEN_RE.match(sub.id) or \
+                    _AXIS_NAME_RE.match(sub.id):
+                return True
+            if sub.id in mesh_names:
+                # x.shape of a mesh value / reusing the field itself
+                parent = ctx.parents.get(sub)
+                if isinstance(parent, ast.Attribute) and \
+                        parent.attr == 'shape':
+                    return True
+        elif isinstance(sub, ast.Attribute):
+            if _MESH_TOKEN_RE.match(sub.attr):
+                return True
+    return False
+
+
+_OWN = '<own>'      # taint label: derived from a full-mesh producer
+
+
+class _FuncMem(object):
+    """Per-function dataflow facts for the NBK5xx rules.
+
+    Taint is *labeled*: every local name carries the set of sources
+    its value derives from — :data:`_OWN` for producer-derived
+    (definitely mesh-sized here) and parameter names for
+    caller-supplied values.  Labels flow through assignments,
+    arithmetic and resolved calls; a callee summary maps argument
+    labels through its own ``ret_params``, so a timing helper that
+    returns floats never inherits its field argument's size."""
+
+    def __init__(self, analysis, ctx, fn):
+        self.analysis = analysis
+        self.ctx = ctx
+        self.fn = fn
+        a = fn.args
+        self.params = [p.arg for p in
+                       a.posonlyargs + a.args + a.kwonlyargs
+                       if p.arg != 'self']
+        self.labels = {}        # name -> frozenset of labels
+        self._infer_taint()
+        self.mesh_own = {n for n, l in self.labels.items()
+                         if _OWN in l}
+        self.intervals = self._intervals()
+
+    # -- taint -------------------------------------------------------------
+
+    def _infer_taint(self):
+        ctx, fn = self.ctx, self.fn
+        for _ in range(3):
+            changed = False
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                         ast.AugAssign)):
+                    continue
+                if ctx.enclosing_function(node) is not fn:
+                    continue
+                value = node.value
+                if value is None:
+                    continue
+                lab = self.expr_labels(value)
+                if not lab:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            old = self.labels.get(n.id, frozenset())
+                            new = old | lab
+                            if new != old:
+                                self.labels[n.id] = new
+                                changed = True
+            if not changed:
+                break
+
+    def expr_labels(self, expr):
+        """Taint labels of an expression's value."""
+        if expr is None:
+            return frozenset()
+        if isinstance(expr, ast.Name):
+            lab = self.labels.get(expr.id, frozenset())
+            if expr.id in self.params:
+                lab = lab | {expr.id}
+            return lab
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = frozenset()
+            for e in expr.elts:
+                out |= self.expr_labels(e)
+            return out
+        if isinstance(expr, ast.BinOp):
+            return self.expr_labels(expr.left) | \
+                self.expr_labels(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_labels(expr.operand)
+        if isinstance(expr, ast.Compare):
+            out = self.expr_labels(expr.left)
+            for c in expr.comparators:
+                out |= self.expr_labels(c)
+            return out
+        if isinstance(expr, ast.IfExp):
+            return self.expr_labels(expr.body) | \
+                self.expr_labels(expr.orelse)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in ('T', 'real', 'imag', 'mT'):
+                return self.expr_labels(expr.value)
+            return frozenset()      # .shape/.dtype/attribute config
+        if isinstance(expr, ast.Call):
+            return self.call_labels(expr)
+        if isinstance(expr, ast.Starred):
+            return self.expr_labels(expr.value)
+        if isinstance(expr, ast.Lambda):
+            return frozenset()      # a function object, not data
+        return frozenset()
+
+    def call_labels(self, call):
+        """Taint labels of a call's *result*."""
+        ctx = self.ctx
+        tail = _call_tail(ctx, call)
+        if tail in REDUCER_TAILS:
+            return frozenset()
+        if tail in PRODUCER_TAILS:
+            return frozenset({_OWN})
+        if tail in ALLOC_TAILS:
+            shape_args = list(call.args) + \
+                [kw.value for kw in call.keywords
+                 if kw.arg in ('shape', 'size')]
+            for s_a in shape_args:
+                if _mesh_shape_like(ctx, s_a, self.mesh_names()):
+                    return frozenset({_OWN})
+            return frozenset()
+        if tail in ALLOC_LIKE_TAILS:
+            out = frozenset()
+            for a_ in call.args:
+                out |= self.expr_labels(a_)
+            return out
+        # interprocedural: resolved callee's return summary, argument
+        # labels mapped through the callee's ret_params
+        project = getattr(ctx, 'project', None)
+        if project is not None:
+            tgt = project.resolve_call(ctx, call)
+            if tgt is not None and tgt.ref is not None and \
+                    tgt.ref.node is not self.fn:
+                summ = self.analysis.summary_of(tgt.ref.node)
+                if summ.returns == 'yes':
+                    return frozenset({_OWN})
+                out = frozenset()
+                if summ.ret_params:
+                    for lab in self._mapped_arg_labels(
+                            call, tgt.ref.node, summ.ret_params):
+                        out |= lab
+                return out
+        # unresolved: elementwise propagation — mesh in, mesh out
+        out = frozenset()
+        if isinstance(call.func, ast.Attribute):
+            out |= self.expr_labels(call.func.value)
+        for a_ in call.args:
+            out |= self.expr_labels(a_)
+        for kw in call.keywords:
+            out |= self.expr_labels(kw.value)
+        return out
+
+    def _mapped_arg_labels(self, call, callee, ret_params):
+        """Labels of the arguments bound to the callee parameters in
+        ``ret_params``."""
+        a = callee.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        offset = 1 if names and names[0] == 'self' else 0
+        for i, arg in enumerate(call.args):
+            pos = i + offset
+            if pos < len(names) and names[pos] in ret_params:
+                yield self.expr_labels(arg)
+        for kw in call.keywords:
+            if kw.arg in ret_params:
+                yield self.expr_labels(kw.value)
+
+    def mesh_names(self):
+        """Producer-derived names known so far (valid mid-inference:
+        computed from the live label table, not the cached set)."""
+        return {n for n, l in self.labels.items() if _OWN in l}
+
+    def _expr_mesh(self, expr, names=None, allow_names=False):
+        """Is the expression definitely mesh-sized *here*?"""
+        return _OWN in self.expr_labels(expr)
+
+    def _call_mesh(self, call, names=None, allow_names=False):
+        return _OWN in self.call_labels(call)
+
+    # -- liveness ----------------------------------------------------------
+
+    def _intervals(self):
+        """{name: [birth_line, death_line]} for own-mesh names."""
+        ctx, fn = self.ctx, self.fn
+        out = {}
+        for node in ast.walk(fn):
+            if ctx.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, ast.Name) and \
+                    node.id in self.mesh_own:
+                line = node.lineno
+                iv = out.setdefault(node.id, [line, line])
+                if isinstance(node.ctx, ast.Store):
+                    iv[0] = min(iv[0], line)
+                    iv[1] = max(iv[1], line)
+                else:
+                    iv[1] = max(iv[1], line)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and \
+                            t.id in self.mesh_own and t.id in out:
+                        out[t.id][1] = max(out[t.id][1],
+                                           node.lineno)
+        return out
+
+    def used_after(self, name, call):
+        """Does the caller still read ``name`` after ``call`` — either
+        later in source order, or on the next iteration of an
+        enclosing loop the name outlives?  A call whose result rebinds
+        the same name (the donated-accumulator idiom
+        ``y = upd(y, ...)``) makes every later read see the NEW
+        binding, so it never counts as holding the donated buffer."""
+        ctx, fn = self.ctx, self.fn
+        parent = ctx.parents.get(call)
+        if isinstance(parent, ast.Assign) and parent.value is call and \
+                any(isinstance(t, ast.Name) and t.id == name
+                    for t in parent.targets):
+            return False
+        line = call.lineno
+        loop = None
+        n = ctx.parents.get(call)
+        while n is not None and n is not fn:
+            if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+                loop = n
+                break
+            n = ctx.parents.get(n)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Name) or node.id != name or \
+                    not isinstance(node.ctx, ast.Load):
+                continue
+            if ctx.enclosing_function(node) is not fn:
+                continue
+            if node.lineno > line:
+                return True
+            if loop is not None and node.lineno >= loop.lineno:
+                # back edge: read again on the next iteration, unless
+                # the name is rebound from itself (the donated-
+                # accumulator idiom ``y = upd(y, ...)``)
+                if not self._rebound_from_call(node, call):
+                    return True
+        return False
+
+    def _rebound_from_call(self, load, call):
+        """True when ``load`` is an argument of ``call`` whose result
+        is immediately re-assigned to the same name (accumulator
+        donation: the buffer handle moves, no second owner)."""
+        ctx = self.ctx
+        n = load
+        while n is not None and n is not call:
+            n = ctx.parents.get(n)
+        if n is not call:
+            return False
+        parent = ctx.parents.get(call)
+        if isinstance(parent, ast.Assign):
+            return any(isinstance(t, ast.Name) and t.id == load.id
+                       for t in parent.targets)
+        return False
+
+    # -- call-site classification -------------------------------------
+
+    def jit_calls(self):
+        """(call, target, mesh positional args) for calls through jit
+        wrappers: [(call, CallTarget, {pos: argnode})]."""
+        ctx, fn = self.ctx, self.fn
+        project = getattr(ctx, 'project', None)
+        if project is None:
+            return []
+        out = []
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call) or \
+                    ctx.enclosing_function(call) is not fn:
+                continue
+            tgt = project.resolve_call(ctx, call)
+            if tgt is None or not tgt.jitted:
+                continue
+            mesh_args = {}
+            for i, a_ in enumerate(call.args):
+                if self._expr_mesh(a_, self.mesh_own):
+                    mesh_args[i] = a_
+            if mesh_args:
+                out.append((call, tgt, mesh_args))
+        return out
+
+    # -- the symbolic peak -------------------------------------------------
+
+    def peak_units(self):
+        ctx, fn = self.ctx, self.fn
+        project = getattr(ctx, 'project', None)
+        extras = collections.defaultdict(float)
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call) or \
+                    ctx.enclosing_function(call) is not fn:
+                continue
+            result_mesh = self._call_mesh(call, self.mesh_own)
+            tgt = project.resolve_call(ctx, call) \
+                if project is not None else None
+            donate = tgt.donate if tgt is not None else frozenset()
+            line = call.lineno
+            # aliasing: a donated mesh argument that dies here hands
+            # its buffer to the result — credit one unit back.  The
+            # lowmem drivers' ownership-box contract aliases the same
+            # way: the boxed field becomes the callee's working buffer
+            owns = _call_tail(ctx, call) in OWNERSHIP_TAILS
+            aliased = False
+            for i, a_ in enumerate(call.args):
+                if i not in donate and not (owns and i == 0):
+                    continue
+                if isinstance(a_, ast.Name) and \
+                        a_.id in self.mesh_own and \
+                        not self.used_after(a_.id, call):
+                    aliased = True
+                elif isinstance(a_, ast.Call) and \
+                        self._call_mesh(a_, self.mesh_own):
+                    aliased = True      # donated temp chains through
+                elif owns and isinstance(a_, ast.List) and \
+                        self._expr_mesh(a_):
+                    aliased = True      # box built in the call itself
+            if result_mesh:
+                parent = ctx.parents.get(call)
+                is_assigned = isinstance(parent, ast.Assign) and \
+                    parent.value is call
+                if aliased:
+                    extras[line] -= 1.0 if is_assigned else 0.0
+                elif not is_assigned:
+                    extras[line] += 1.0     # transient mesh temp
+            # callee internal excess beyond its (counted) result
+            internal = 0.0
+            if tgt is not None and tgt.ref is not None and \
+                    tgt.ref.node is not fn:
+                internal = self.analysis.summary_of(
+                    tgt.ref.node).peak
+            else:
+                internal = _PRODUCER_INTERNAL.get(
+                    _call_tail(ctx, call) or '', 0.0)
+            if internal:
+                extras[line] += max(
+                    0.0, internal - (1.0 if result_mesh else 0.0))
+        lines = set(extras)
+        for birth, death in self.intervals.values():
+            lines.add(birth)
+            lines.add(death)
+        peak = 0.0
+        for line in lines:
+            live = sum(1.0 for (b, d) in self.intervals.values()
+                       if b <= line <= d)
+            peak = max(peak, live + extras.get(line, 0.0))
+        return peak
+
+    def returns_kind(self):
+        """('no'|'yes', frozenset of return-flowing param names)."""
+        fn = self.fn
+        exprs = [fn.body] if isinstance(fn, ast.Lambda) else [
+            node.value for node in ast.walk(fn)
+            if isinstance(node, ast.Return) and node.value is not None
+            and self.ctx.enclosing_function(node) is fn]
+        labels = frozenset()
+        for e in exprs:
+            labels |= self.expr_labels(e)
+        if _OWN in labels:
+            return 'yes', frozenset()
+        return 'no', labels & frozenset(self.params)
+
+
+class _Analysis(object):
+    """Project-wide fixpoint of MemSummary per function."""
+
+    def __init__(self, project):
+        self.project = project
+        self.summaries = {}
+        self._func_mem = {}
+        for _ in range(6):
+            changed = False
+            for ctx, fn in project.functions():
+                fm = _FuncMem(self, ctx, fn)
+                returns, ret_params = fm.returns_kind()
+                summ = MemSummary(returns, ret_params,
+                                  round(fm.peak_units(), 2))
+                if summ != self.summaries.get(id(fn)):
+                    self.summaries[id(fn)] = summ
+                    changed = True
+                self._func_mem[id(fn)] = fm
+            if not changed:
+                break
+
+    def summary_of(self, fn):
+        return self.summaries.get(
+            id(fn), MemSummary('no', frozenset(), 0.0))
+
+    def func_mem(self, fn):
+        return self._func_mem.get(id(fn))
+
+
+def analysis_for(project):
+    cached = getattr(project, '_mem_analysis', None)
+    if cached is None:
+        cached = _Analysis(project)
+        project._mem_analysis = cached
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# rule entry points (wrapped into Findings by rules.py)
+
+
+def find_undonated(ctx):
+    """NBK501 raw findings: (call, argname, position)."""
+    project = _project_of(ctx)
+    analysis = analysis_for(project)
+    out = []
+    for fn in ctx.functions:
+        fm = analysis.func_mem(fn)
+        if fm is None:
+            continue
+        for call, tgt, mesh_args in fm.jit_calls():
+            for pos, arg in sorted(mesh_args.items()):
+                if pos in tgt.donate:
+                    continue
+                if not isinstance(arg, ast.Name):
+                    # producer-call temps chain through donation too,
+                    # but the *name* form is the actionable one; temps
+                    # without donation are covered by the peak report
+                    continue
+                if fm.used_after(arg.id, call):
+                    continue        # donation would be wrong here
+                out.append((call, arg.id, pos))
+    return out
+
+
+def find_held_donations(ctx):
+    """NBK502 raw findings: (call, argname, position)."""
+    project = _project_of(ctx)
+    analysis = analysis_for(project)
+    out = []
+    for fn in ctx.functions:
+        fm = analysis.func_mem(fn)
+        if fm is None:
+            continue
+        for call, tgt, mesh_args in fm.jit_calls():
+            for pos, arg in sorted(mesh_args.items()):
+                if pos not in tgt.donate:
+                    continue
+                if isinstance(arg, ast.Name) and \
+                        fm.used_after(arg.id, call):
+                    out.append((call, arg.id, pos))
+    return out
+
+
+def find_over_budget(ctx):
+    """NBK503 raw findings: (fn, name, peak_units, peak_bytes) for a
+    declared memory config."""
+    project = _project_of(ctx)
+    config = getattr(project, 'memory_config', None)
+    if config is None:
+        return []
+    analysis = analysis_for(project)
+    ub = unit_bytes(config)
+    out = []
+    for fn in ctx.functions:
+        summ = analysis.summary_of(fn)
+        peak_bytes = summ.peak * ub
+        if peak_bytes > config.budget_bytes:
+            out.append((fn, _func_label(fn), summ.peak, peak_bytes))
+    return out
+
+
+def _project_of(ctx):
+    project = getattr(ctx, 'project', None)
+    if project is None:
+        from .callgraph import single_project
+        project = single_project(ctx)
+    return project
+
+
+def _func_label(fn):
+    if isinstance(fn, ast.Lambda):
+        return '<lambda:%d>' % fn.lineno
+    return fn.name
+
+
+# ---------------------------------------------------------------------------
+# the memory report
+
+
+def memory_budget(config, npart=None):
+    """(budget_bytes, source string).  Prefers the live
+    ``pmesh.memory_plan`` arithmetic when the project is importable
+    (the doctor / developer-laptop path); falls back to the same 15%
+    allocator margin the plan applies when it is not (the stdlib-only
+    CI path)."""
+    try:
+        from ..pmesh import memory_plan
+        plan = memory_plan(config.nmesh, npart or 0,
+                           hbm_bytes=config.hbm_bytes)
+        return (0.85 * config.hbm_bytes,
+                'pmesh.memory_plan(nmesh=%d): plan peak %.2f GB, '
+                'budget 0.85*HBM' % (config.nmesh,
+                                     plan['peak_bytes'] / 1e9))
+    except Exception:
+        return (config.budget_bytes,
+                '0.85 * %.0f GB HBM (memory_plan margin; plan not '
+                'importable here)' % (config.hbm_bytes / 1e9))
+
+
+def memory_report(project, config, npart=None):
+    """Rows for the ``--memory-report`` table, biggest peak first:
+    dicts of module, function, line, peak_units, peak_bytes, over."""
+    analysis = analysis_for(project)
+    budget, source = memory_budget(config, npart=npart)
+    ub = unit_bytes(config)
+    rows = []
+    for ctx, fn in project.functions():
+        summ = analysis.summary_of(fn)
+        if summ.peak <= 0:
+            continue
+        peak_bytes = summ.peak * ub
+        rows.append({
+            'module': getattr(ctx, 'module', ctx.path),
+            'path': getattr(ctx, 'canonical', ctx.path),
+            'function': _func_label(fn),
+            'line': fn.lineno,
+            'peak_units': summ.peak,
+            'peak_bytes': peak_bytes,
+            'over_budget': peak_bytes > budget,
+        })
+    rows.sort(key=lambda r: (-r['peak_units'], r['path'], r['line']))
+    return {'config': {'nmesh': config.nmesh,
+                       'dtype_bytes': config.dtype_bytes,
+                       'hbm_bytes': config.hbm_bytes,
+                       'unit_bytes': ub},
+            'budget_bytes': budget, 'budget_source': source,
+            'rows': rows}
+
+
+def render_memory_report(report):
+    """The report as aligned text."""
+    cfg = report['config']
+    out = ['== nbkl memory report: nmesh=%d, %d-byte dtype '
+           '(1 unit = %.2f GB), budget %.2f GB =='
+           % (cfg['nmesh'], cfg['dtype_bytes'],
+              cfg['unit_bytes'] / 1e9, report['budget_bytes'] / 1e9),
+           'budget: %s' % report['budget_source']]
+    rows = report['rows']
+    if not rows:
+        out.append('no function holds a full-mesh buffer '
+                   '(or none was recognized)')
+        return '\n'.join(out) + '\n'
+    fw = max(len('%s:%s' % (r['path'], r['function'])) for r in rows)
+    for r in rows:
+        out.append('  %-*s  %5.1f units  %7.2f GB  %s'
+                   % (fw, '%s:%s' % (r['path'], r['function']),
+                      r['peak_units'], r['peak_bytes'] / 1e9,
+                      'OVER BUDGET' if r['over_budget'] else 'ok'))
+    over = sum(1 for r in rows if r['over_budget'])
+    out.append('%d function(s), %d over budget' % (len(rows), over))
+    return '\n'.join(out) + '\n'

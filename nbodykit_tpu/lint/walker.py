@@ -100,10 +100,13 @@ def _suppressed(finding, per_line, file_wide):
     return False
 
 
-def lint_source(path, source, project_constants=None, select=None):
+def lint_source(path, source, project_constants=None, select=None,
+                memory_config=None):
     """Findings for one module's source text (suppressions applied).
     A syntax error comes back as a single NBK000 finding rather than
-    an exception — the linter must be safe on broken code."""
+    an exception — the linter must be safe on broken code.  The
+    interprocedural rules run against a one-module project here; the
+    multi-module form is :func:`lint_paths`."""
     try:
         ctx = ModuleContext(path, source,
                             project_constants=project_constants)
@@ -113,6 +116,8 @@ def lint_source(path, source, project_constants=None, select=None):
                         'syntax error: %s' % e.msg,
                         'fix the parse error; no other rule ran on '
                         'this file')]
+    from .callgraph import single_project
+    single_project(ctx, memory_config=memory_config)
     findings = run_rules(ctx, select=select)
     per_line, file_wide = _line_suppressions(ctx.lines)
     return [f for f in findings
@@ -142,14 +147,17 @@ def collect_project_constants(files):
     return consts
 
 
-def lint_paths(paths, select=None, project_constants=None):
-    """Lint every target file under ``paths``; returns findings with
-    canonical (repo-relative) paths, sorted."""
+def build_project(paths, project_constants=None, memory_config=None):
+    """Parse every target file and assemble the interprocedural
+    :class:`~nbodykit_tpu.lint.callgraph.Project`.  Returns
+    ``(project, parse_findings)`` — unreadable/unparsable files become
+    NBK000 findings instead of exceptions."""
+    from .callgraph import Project
     files = list(iter_target_files(paths))
     consts = dict(project_constants or {})
     if not consts:
         consts = collect_project_constants(files)
-    findings = []
+    contexts, findings = [], []
     for path in files:
         try:
             with open(path, encoding='utf-8') as f:
@@ -159,18 +167,48 @@ def lint_paths(paths, select=None, project_constants=None):
                 'NBK000', canonical_path(path), 1, 0,
                 'unreadable: %s' % e, 'fix the file permissions/path'))
             continue
-        for f_ in lint_source(path, source, project_constants=consts,
-                              select=select):
-            findings.append(f_._replace(path=canonical_path(path)))
+        try:
+            ctx = ModuleContext(path, source,
+                                project_constants=consts)
+        except SyntaxError as e:
+            findings.append(Finding(
+                'NBK000', canonical_path(path), e.lineno or 1,
+                (e.offset or 1) - 1, 'syntax error: %s' % e.msg,
+                'fix the parse error; no other rule ran on this '
+                'file'))
+            continue
+        ctx.canonical = canonical_path(path)
+        contexts.append(ctx)
+    project = Project(contexts, memory_config=memory_config)
+    return project, findings
+
+
+def lint_paths(paths, select=None, project_constants=None,
+               memory_config=None):
+    """Lint every target file under ``paths``; returns findings with
+    canonical (repo-relative) paths, sorted.  All files are parsed
+    into one project first so the interprocedural rules (NBK103,
+    NBK5xx) see cross-module call edges."""
+    project, findings = build_project(
+        paths, project_constants=project_constants,
+        memory_config=memory_config)
+    for ctx in project.contexts:
+        per_line, file_wide = _line_suppressions(ctx.lines)
+        for f_ in run_rules(ctx, select=select):
+            if _suppressed(f_, per_line, file_wide):
+                continue
+            findings.append(f_._replace(path=ctx.canonical))
     return sorted(findings,
                   key=lambda f: (f.path, f.line, f.col, f.code))
 
 
 def default_targets(root=None):
     """The package's own lint surface: ``nbodykit_tpu/`` plus the
-    multi-host worker (a collective program outside the package).
-    ``root`` defaults to the repo checkout guessed from this file;
-    falls back to the installed package directory."""
+    multi-host worker (a collective program outside the package) and
+    the bench driver (whose staged ladder is exactly the donation
+    surface NBK5xx exists for).  ``root`` defaults to the repo
+    checkout guessed from this file; falls back to the installed
+    package directory."""
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -178,9 +216,10 @@ def default_targets(root=None):
     if not os.path.isdir(pkg):
         pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     targets = [pkg]
-    worker = os.path.join(root, 'tests', '_multihost_worker.py')
-    if os.path.isfile(worker):
-        targets.append(worker)
+    for extra in (os.path.join(root, 'tests', '_multihost_worker.py'),
+                  os.path.join(root, 'bench.py')):
+        if os.path.isfile(extra):
+            targets.append(extra)
     return targets
 
 

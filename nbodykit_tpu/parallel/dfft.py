@@ -108,6 +108,13 @@ def rfftn_single_lowmem(x_box, norm=None, target=None):
     path) build the box in-place and call this driver directly.
     Returns the transposed (N1, N0, Nc) layout of :func:`dist_rfftn`.
     Not traceable: call outside jit.
+
+    This contract is MACHINE-CHECKED since nbkl v2: the linter's
+    symbolic peak model (``nbodykit-tpu-lint --memory-report``)
+    derives exactly 2.0 full-mesh units for this driver from the
+    source — donated ``upd`` programs alias the accumulator, the
+    ``del x`` ends the input's live range before pass B — and
+    ``tests/test_lint_dataflow.py`` fails if an edit regresses it.
     """
     if isinstance(x_box, (list,)):
         x = x_box.pop()

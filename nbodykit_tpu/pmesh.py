@@ -445,6 +445,11 @@ class ParticleMesh(object):
                     "capacity=%d" % (int(dropped), capacity))
                 block, dropped, over = attempt(capacity)
             if int(dropped) > 0:
+                # NBK103 (baselined, audited): this raise sits between
+                # collective stages, but `dropped` is the
+                # globally-summed overflow count — every rank computes
+                # the same value and raises together, so the exception
+                # path is rank-uniform by construction
                 raise RuntimeError(
                     "particle exchange still overflowing at the "
                     "maximal capacity %d — this should be impossible"

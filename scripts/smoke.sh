@@ -27,10 +27,49 @@ python -m nbodykit_tpu.diagnostics --regress .
 
 # shard-safety lint gate: any finding not grandfathered in the
 # committed lint_baseline.json fails the smoke run (the module form
-# works without installing the nbodykit-tpu-lint console script)
+# works without installing the nbodykit-tpu-lint console script).
+# Since nbkl v2 the surface includes bench.py and the interprocedural
+# NBK103/NBK5xx analyses run as part of the same gate.
 echo "== shard-safety lint gate =="
 python -m nbodykit_tpu.lint --baseline lint_baseline.json \
-    nbodykit_tpu/ tests/_multihost_worker.py
+    nbodykit_tpu/ tests/_multihost_worker.py bench.py
+
+# machine-readable per-family counts: the gate consumes the --stats
+# JSON so a new finding in ANY family (incl. NBK103/NBK5xx) fails
+# loudly with the per-family split, and regress.py records the same
+# shape per round in BENCH_HISTORY.json
+echo "== lint stats gate (per-family JSON) =="
+python -m nbodykit_tpu.lint --stats --baseline lint_baseline.json \
+    nbodykit_tpu/ tests/_multihost_worker.py bench.py | python -c '
+import json, sys
+stats = json.load(sys.stdin)
+assert stats["gate"] == "OK", stats
+assert stats["total"]["new"] == 0, stats
+fams = stats["families"]
+missing = {"NBK1", "NBK2", "NBK3", "NBK4", "NBK5"} - set(fams)
+assert not missing, "family axis missing: %s" % missing
+print("lint stats OK: " + "  ".join(
+    "%s=%d+%d" % (k, v["new"], v["baselined"])
+    for k, v in sorted(fams.items())))
+'
+
+# bounded symbolic-peak report for the north-star 1024^3 config
+# (bench staged ladder + the dfft lowmem drivers): proves the
+# documented buffer contracts still derive from the source, and that
+# the donated staged chain stays inside the v5e budget while only the
+# (staged-gated) fused pipeline exceeds it
+echo "== memory report: 1024^3 north-star config (bounded) =="
+python -m nbodykit_tpu.lint --memory-report --nmesh 1024 \
+    --npart 1e8 bench.py nbodykit_tpu/parallel/dfft.py | python -c '
+import sys
+text = sys.stdin.read()
+sys.stdout.write(text)
+assert "OVER BUDGET" in text, "fused pipeline should exceed budget"
+for fn in ("run_once", "rfftn_single_lowmem"):
+    line = next(l for l in text.splitlines() if fn in l)
+    assert "OVER BUDGET" not in line, (
+        "staged/lowmem chain exceeded the budget: " + line)
+'
 
 # autotuner gates (docs/TUNE.md): the bounded --dry-run proves the
 # deterministic trial plan still builds without touching a device;
@@ -77,6 +116,7 @@ python -m pytest \
     tests/test_resilience.py \
     tests/test_tune.py \
     tests/test_lint.py \
+    tests/test_lint_dataflow.py \
     tests/test_jax_compat.py \
     tests/test_pmesh.py \
     tests/test_fftpower.py \
