@@ -428,7 +428,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     # process must not leak non-default engines into a rung labeled
     # only by paint_method
     nbodykit_tpu.set_options(paint_method=method, paint_order='auto',
-                             paint_deposit='auto')
+                             paint_deposit='auto', paint_streams='auto',
+                             paint_chunk_size=1024 * 1024 * 16)
     from nbodykit_tpu.diagnostics import span as _span
     from nbodykit_tpu.diagnostics import instrumented_jit as _ijit
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
@@ -885,45 +886,94 @@ def run_fftbw(Nmesh=512, reps=3):
     return _stamp(rec)
 
 
+def _paint_method_options(method, Nmesh, Npart):
+    """``set_options`` kwargs selecting one paint configuration by
+    name.
+
+    Accepts (1) any REGISTERED tuner candidate name for this shape
+    ('scatter', 'sort', 'segsum-radix', 'streams4', 'mxu-radix-xla',
+    ... — tune/space.py), so bench measurements and trials select
+    identical programs; (2) the legacy suffix grammar
+    'mxu:ORDER[:DEPOSIT]', 'segsum:ORDER' and 'streams:K'.  Every
+    option a configuration does NOT pin is reset to its default — a
+    prior call in this process must not leak engines into a
+    differently-labeled measurement.
+    """
+    from nbodykit_tpu.tune.space import registered_paint_candidates
+    base = {'paint_order': 'auto', 'paint_deposit': 'auto',
+            'paint_streams': 'auto',
+            'paint_chunk_size': 1024 * 1024 * 16}
+    for cand in registered_paint_candidates(Nmesh, Npart):
+        if cand.name == method:
+            opts = dict(base)
+            opts.update(cand.options)
+            return opts
+    opts = dict(base)
+    if ':' in method:
+        parts = method.split(':')
+        method = parts[0]
+        if method == 'streams':
+            opts['paint_streams'] = int(parts[1])
+        else:
+            opts['paint_order'] = parts[1]
+        if len(parts) > 2:
+            opts['paint_deposit'] = parts[2]
+    opts['paint_method'] = method
+    return opts
+
+
 def run_paint(Nmesh, Npart, method='scatter', reps=3):
     """Paint-only microbenchmark (the #1 perf risk, SURVEY §7).
 
-    ``method`` may carry engine suffixes for the mxu kernel:
-    'mxu:ORDER[:DEPOSIT]' with ORDER in {radix, argsort, auto} and
-    DEPOSIT in {xla, pallas, auto} — A/B of the bucketing order
-    (ops/radix.py vs bitonic lax sort) and the deposit engine (XLA
-    one-hot expansions vs the fused Pallas VMEM kernel).
+    ``method`` is a registered tuner candidate name or a legacy
+    'METHOD[:ORDER[:DEPOSIT]]' / 'streams:K' spec
+    (:func:`_paint_method_options`).  The record carries the summed
+    painted mass (``mass_sum``) so gates can reject a kernel that
+    lowers but deposits NaNs.
     """
     jax = _setup_jax()
     import jax.numpy as jnp
     import nbodykit_tpu
     from nbodykit_tpu.pmesh import ParticleMesh
 
-    method_label = method      # metric key keeps the suffixes
-    order = dep = 'auto'       # no suffix -> reset (a prior suffixed
-    if ':' in method:          # call set the process-global options)
-        parts = method.split(':')
-        method, order = parts[0], parts[1]
-        if len(parts) > 2:
-            dep = parts[2]
-    nbodykit_tpu.set_options(paint_method=method, paint_order=order,
-                             paint_deposit=dep)
+    method_label = method      # metric key keeps the candidate name
+    nbodykit_tpu.set_options(**_paint_method_options(
+        method, Nmesh, Npart))
     pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic',
                                     return_dropped=True)[0])
     dt, _ = _time_fn(jax, fn, (pos,), reps,
                      label='paint_%s' % method_label)
+    mass_sum = float(jnp.sum(fn(pos)))
     from nbodykit_tpu.tune.resolve import tuned_snapshot
     return _stamp({
         "metric": "paint_wallclock_nmesh%d_npart%.0e_%s"
                   % (Nmesh, Npart, method_label),
         "value": round(dt, 4), "unit": "s",
         "mpart_per_s": round(Npart / dt / 1e6, 1),
+        "mass_sum": mass_sum,
         "platform": jax.devices()[0].platform,
         "tuned": tuned_snapshot(nmesh=Nmesh, npart=Npart, dtype='f4',
                                 nproc=pm.nproc),
     })
+
+
+def run_paint_all(Nmesh, Npart, reps=3):
+    """Every registered paint candidate at one shape, one record each
+    (the smoke gate's CI sweep and the pre-hardware baseline for
+    ROADMAP #1).  A candidate that raises is recorded with an
+    ``error`` field instead of killing the sweep — the gate decides.
+    """
+    from nbodykit_tpu.tune.space import registered_paint_candidates
+    out = {}
+    for cand in registered_paint_candidates(Nmesh, Npart):
+        try:
+            out[cand.name] = run_paint(Nmesh, Npart, cand.name,
+                                       reps=reps)
+        except Exception as e:                      # gate fodder
+            out[cand.name] = {"error": str(e)[:300]}
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1433,6 +1483,11 @@ if __name__ == '__main__':
     if argv[0] == '--paint':
         print(json.dumps(run_paint(int(argv[1]), int(argv[2]),
                                    *(argv[3:4] or ['scatter']))))
+        sys.exit(0)
+    if argv[0] == '--paint-all':
+        print(json.dumps(run_paint_all(
+            int(argv[1]), int(argv[2]),
+            reps=int(argv[3]) if argv[3:] else 3)))
         sys.exit(0)
     print("unknown args: %r" % (argv,), file=sys.stderr)
     sys.exit(2)

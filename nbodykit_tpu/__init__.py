@@ -58,6 +58,11 @@ _default_options = {
     # expansions via XLA) or 'pallas' (fused VMEM kernel,
     # ops/paint_pallas.py)
     'paint_deposit': 'auto',
+    # replica-mesh count for the 'streams' paint kernel (the number of
+    # independent scatter chains; each replica is a full mesh buffer —
+    # memory_plan counts them against the HBM budget). 'auto' takes
+    # the tune-cache winner, falling back to 4
+    'paint_streams': 'auto',
     # single-device FFTs whose complex output exceeds this many bytes
     # run as slab-chunked per-axis passes (a single FFT op over a
     # multi-GB buffer exceeds TPU compiler limits; see parallel/dfft).
@@ -157,13 +162,19 @@ class set_options(object):
     resampler : str
         default window: 'nnb', 'cic', 'tsc', 'pcs'.
     paint_method : str
-        'scatter', 'sort', 'mxu' — the local deposit kernel — or
-        'auto': the measured winner recorded in the tune cache for
-        this platform/device/shape (:mod:`nbodykit_tpu.tune`,
-        docs/TUNE.md); a cold cache resolves to 'scatter' at zero
-        trial cost.
+        'scatter', 'sort', 'segsum', 'streams', 'mxu' — the local
+        deposit kernel — or 'auto': the measured winner recorded in
+        the tune cache for this platform/device/shape
+        (:mod:`nbodykit_tpu.tune`, docs/TUNE.md); a cold cache
+        resolves to 'scatter' at zero trial cost.
     paint_bucket_slack : float
         bucket-capacity slack factor for the 'mxu' paint kernel.
+    paint_streams : int or 'auto'
+        replica-mesh count for the 'streams' paint kernel — the number
+        of independent scatter chains the s^3 window-offset streams
+        are dealt onto (each replica is a full mesh buffer, counted by
+        ``memory_plan``); 'auto' consults the tune cache, falling
+        back to 4.
     fft_chunk_bytes : int or 'auto'
         single-device FFTs with complex output larger than this run as
         slab-chunked per-axis passes (0 disables); 'auto' consults the

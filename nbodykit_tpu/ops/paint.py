@@ -172,33 +172,33 @@ def readout_local(block, pos, resampler='cic', period=None, origin=0,
     return vals.reshape(-1)[:n]
 
 
-def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
-                      origin=0, out=None, npasses=None):
-    """Collision-free paint: sort + segmented reduction + unique scatter.
+def _one_sort_streams(pos, mass, shape, resampler, period, origin,
+                      dtype, order_method='argsort'):
+    """Shared preamble of the one-sort deposit kernels
+    (:func:`paint_local_sorted`, :func:`paint_local_segsum`).
 
-    TPU scatter-add serializes on colliding indices. Here all (cell,
-    weight) deposit terms are sorted by cell, each equal-cell run is
-    summed with doubling shift-add passes (exact — no global cumsum, so
-    f32 precision is preserved), the per-run totals are compacted to one
-    entry per distinct cell, and a single scatter with *provably unique*
-    indices deposits them (``unique_indices=True`` — XLA needs no
-    serialization). Unused compaction slots get distinct out-of-bounds
-    indices and are dropped, keeping the uniqueness claim honest.
+    ONE stable ordering of the n base cells (not the s^3*n deposit
+    terms): for every window offset (a,b,c) the un-wrapped deposit key
+    is the base key plus the constant d=(a*N1+b)*N2+c, so base order
+    keeps equal deposit keys contiguous for every offset
+    simultaneously, and the segment structure (run boundaries) is
+    SHARED — wrap status and cell indices are functions of the base
+    cell alone.
 
-    The shift loop runs as a lax.while_loop until no run spans the
-    current shift, so arbitrarily long collision runs are summed exactly
-    (cost: log2(max occupancy) passes).
+    Returns ``(keys, is_start, is_last, idx, offs, W, fbk, fbv,
+    sent)``: the sorted base keys, run-start/run-end masks, the slot
+    iota, the s^3 constant key offsets, the (s^3, n) un-wrapped weight
+    streams in base-sorted order, the concatenated plain-scatter
+    fallback stream (keys, values) for wrapped/out-of-block deposits,
+    and the dropped-slot sentinel base.
 
-    Memory is O(n * s^3) beyond the output block — unlike the round-1
-    sentinel design there is no O(M) term, so this scales to
-    Nmesh=1024 (M=1e9) meshes.
-
-    npasses : optional static cap on the doubling passes (mostly for
-        testing); None iterates to completion.
+    order_method : stable ordering engine for the one rank
+        (:func:`~nbodykit_tpu.ops.radix.order_keys` — 'argsort',
+        'radix' over the [0, M) cell alphabet, or the 'auto' hardware
+        heuristic). Both engines are stable, so the run structure is
+        engine-independent.
     """
     n0l, N1, N2 = (int(x) for x in shape)
-    if period is None:
-        period = shape
     period = tuple(int(p) for p in period)
     n = pos.shape[0]
     M = n0l * N1 * N2
@@ -208,21 +208,10 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     # formed is the dropped-slot sentinel M + (s-1)*(N1*N2+N2+1) + 1
     if M + (s - 1) * (N1 * N2 + N2 + 1) + 1 > np.iinfo(np.int32).max:
         raise ValueError(
-            "paint_local_sorted: local block %dx%dx%d (+window %d) "
+            "one-sort paint: local block %dx%dx%d (+window %d) "
             "overflows the int32 flat index; shard the mesh over more "
             "devices so n0_local*N1*N2 < 2**31" % (n0l, N1, N2, s))
-    dtype = out.dtype if out is not None else (
-        mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
-    counter('paint.trace.sort').add(1)
-    counter('paint.trace.sort_particles').add(int(n))
-    mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
 
-    # ONE sort, of the n base cells (not the s^3*n deposit terms): for
-    # every window offset (a,b,c) the un-wrapped deposit key is the
-    # base key plus the constant d=(a*N1+b)*N2+c, so base order keeps
-    # equal deposit keys contiguous for every offset simultaneously,
-    # and the segment structure (run boundaries) is SHARED — wrap
-    # status and cell indices are functions of the base cell alone.
     i0, w0 = _axis_terms(pos[:, 0], resampler, period[0])
     i1, w1 = _axis_terms(pos[:, 1], resampler, period[1])
     i2, w2 = _axis_terms(pos[:, 2], resampler, period[2])
@@ -233,7 +222,10 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     lin_base = ((jnp.where(valid0, row0, 0) * N1
                  + i1[:, 0].astype(jnp.int32)) * N2
                 + i2[:, 0].astype(jnp.int32))
-    order = jnp.argsort(lin_base)
+    from .radix import order_keys
+    # lin_base is provably in [0, M) (row clamped, i1/i2 wrapped), so
+    # the radix engine's alphabet is the cell count
+    order = order_keys(lin_base, M, order_method)
     i0s, i1s, i2s = i0[order], i1[order], i2[order]
     w0s = w0[order].astype(dtype)
     w1s = w1[order].astype(dtype)
@@ -243,16 +235,16 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     row0s, valid0s = row0[order], valid0[order]
 
     idx = jnp.arange(n, dtype=jnp.int32)
-    is_last = jnp.concatenate([keys[1:] != keys[:-1],
-                               jnp.ones((1,), bool)]) if n else \
-        jnp.zeros((0,), bool)
+    if n:
+        neq = keys[1:] != keys[:-1]
+        is_last = jnp.concatenate([neq, jnp.ones((1,), bool)])
+        is_start = jnp.concatenate([jnp.ones((1,), bool), neq])
+    else:
+        is_last = is_start = jnp.zeros((0,), bool)
     # dropped-slot sentinel base: strictly above every possible
     # keys + d (d <= (s-1)*(N1*N2+N2+1)), so sentinels can never
     # collide with a wrapped run's out-of-block key + d
     sent = M + (s - 1) * (N1 * N2 + N2 + 1) + 1
-
-    flat = jnp.zeros(M, dtype=dtype) if out is None else \
-        jnp.asarray(out).reshape(-1)
 
     # per-offset deposit values, exact keys, and wrap status — all in
     # base-sorted order. Entries that wrap (periodic boundary) or fall
@@ -299,15 +291,58 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
                 fb_keys.append(jnp.where(fb, fkey, lin))
                 fb_vals.append(jnp.where(fb, 0, w))
 
-    if fb_keys:
-        flat = flat.at[jnp.concatenate(fb_keys)].add(
-            jnp.concatenate(fb_vals), mode='drop')
+    W = jnp.stack(wsegs)                      # (s^3, n)
+    return (keys, is_start, is_last, idx, offs, W,
+            jnp.concatenate(fb_keys), jnp.concatenate(fb_vals), sent)
+
+
+def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
+                      origin=0, out=None, npasses=None):
+    """Collision-free paint: sort + segmented reduction + unique scatter.
+
+    TPU scatter-add serializes on colliding indices. Here all (cell,
+    weight) deposit terms are sorted by cell (ONE sort of the n base
+    cells — :func:`_one_sort_streams`), each equal-cell run is
+    summed with doubling shift-add passes (exact — no global cumsum, so
+    f32 precision is preserved), the per-run totals are compacted to one
+    entry per distinct cell, and a single scatter with *provably unique*
+    indices deposits them (``unique_indices=True`` — XLA needs no
+    serialization). Unused compaction slots get distinct out-of-bounds
+    indices and are dropped, keeping the uniqueness claim honest.
+
+    The shift loop runs as a lax.while_loop until no run spans the
+    current shift, so arbitrarily long collision runs are summed exactly
+    (cost: log2(max occupancy) passes).
+
+    Memory is O(n * s^3) beyond the output block — unlike the round-1
+    sentinel design there is no O(M) term, so this scales to
+    Nmesh=1024 (M=1e9) meshes.
+
+    npasses : optional static cap on the doubling passes (mostly for
+        testing); None iterates to completion.
+    """
+    n0l, N1, N2 = (int(x) for x in shape)
+    if period is None:
+        period = shape
+    n = pos.shape[0]
+    M = n0l * N1 * N2
+    dtype = out.dtype if out is not None else (
+        mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
+    counter('paint.trace.sort').add(1)
+    counter('paint.trace.sort_particles').add(int(n))
+    mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
+
+    keys, _, is_last, idx, offs, W, fbk, fbv, sent = _one_sort_streams(
+        pos, mass, shape, resampler, period, origin, dtype, 'argsort')
+
+    flat = jnp.zeros(M, dtype=dtype) if out is None else \
+        jnp.asarray(out).reshape(-1)
+    flat = flat.at[fbk].add(fbv, mode='drop')
 
     # shared segmented inclusive prefix sum, vectorized over the s^3
     # offsets: doubling shift-add passes; afterwards the last element
     # of each run holds the run total. Exact — no global cumsum, f32
     # precision preserved.
-    W = jnp.stack(wsegs)                      # (s^3, n)
     max_shift = n if npasses is None else min(n, 1 << npasses)
 
     def cond(state):
@@ -342,6 +377,152 @@ def paint_local_sorted(pos, mass, shape, resampler='cic', period=None,
     return flat.reshape(shape)
 
 
+def paint_local_segsum(pos, mass, shape, resampler='cic', period=None,
+                       origin=0, out=None, order_method='argsort'):
+    """One-sort paint with ``jax.ops.segment_sum`` run reduction.
+
+    Same single-rank trick as :func:`paint_local_sorted` (ONE stable
+    ordering of the n base cells, shared run structure across all s^3
+    window offsets — :func:`_one_sort_streams`), but the per-run
+    reduction is a single ``segment_sum`` over all s^3 weight streams
+    at once (``indices_are_sorted=True`` — one linear pass, no
+    data-dependent while_loop) instead of log2(max occupancy) doubling
+    shift-add passes. The run totals are gathered back to their run's
+    START slot and deposited with one provably-unique scatter per
+    offset, exactly mirroring the sorted kernel's run-END compaction.
+
+    order_method : stable ordering engine for the one rank —
+        'argsort', 'radix' (:func:`~nbodykit_tpu.ops.radix.
+        stable_key_order` over the [0, M) cell alphabet), or 'auto'
+        (the hardware heuristic). The tuner's ``paint_order`` knob.
+
+    Semantics (global cell units, ``origin``/``period``, out-of-block
+    masking) match :func:`paint_local` exactly; equivalence is
+    asserted per-candidate in tests/test_paint_kernels.py.
+    """
+    n0l, N1, N2 = (int(x) for x in shape)
+    if period is None:
+        period = shape
+    n = pos.shape[0]
+    M = n0l * N1 * N2
+    dtype = out.dtype if out is not None else (
+        mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
+    counter('paint.trace.segsum').add(1)
+    counter('paint.trace.segsum_particles').add(int(n))
+    mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
+
+    keys, is_start, _, idx, offs, W, fbk, fbv, sent = _one_sort_streams(
+        pos, mass, shape, resampler, period, origin, dtype,
+        order_method)
+
+    flat = jnp.zeros(M, dtype=dtype) if out is None else \
+        jnp.asarray(out).reshape(-1)
+    flat = flat.at[fbk].add(fbv, mode='drop')
+
+    # run index per sorted slot: 0-based segment ids, monotonically
+    # non-decreasing because the slots are key-sorted — so ONE
+    # segment_sum reduces every run of every offset stream at once
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    totals = jax.ops.segment_sum(W.T, seg, num_segments=max(n, 1),
+                                 indices_are_sorted=True)   # (n, s^3)
+    run_tot = jnp.take(totals, seg, axis=0)                 # (n, s^3)
+
+    # one provably-unique scatter per offset: run-START entries carry
+    # their run total to base_key + d; all others get distinct
+    # out-of-bounds indices and are dropped (same uniqueness argument
+    # as paint_local_sorted's run-end compaction)
+    for j, d in enumerate(offs):
+        skeys = jnp.where(is_start, keys + d, sent + idx)
+        flat = flat.at[skeys].add(jnp.where(is_start, run_tot[:, j], 0),
+                                  mode='drop', unique_indices=True)
+    return flat.reshape(shape)
+
+
+def paint_local_streams(pos, mass, shape, resampler='cic', period=None,
+                        origin=0, out=None, streams=4, chunk=None):
+    """Offset-stream scatter: k independent scatter chains, one sum.
+
+    XLA lowers scatter-add to a serial per-element loop and the plain
+    kernel threads ALL s^3 per-offset deposit streams through ONE mesh
+    buffer, so every update serializes behind the last. But the s^3
+    window-offset streams are algebraically independent (the CIC/TSC
+    decompositions of Jing 2005, astro-ph/0409240, and Cui et al. 2008,
+    0804.0070): offset j only ever touches cell ``base + d_j``. Here
+    the offsets are dealt round-robin onto ``k = streams`` mesh
+    replicas, giving XLA k data-independent scatter chains to overlap,
+    and the replicas are pairwise tree-summed once at the end.
+
+    The price is k-1 extra mesh-sized buffers — replicas count as full
+    mesh units in the NBK5xx symbolic-peak model, so
+    :meth:`~nbodykit_tpu.pmesh.ParticleMesh.memory_plan` grows
+    ``paint_tmp`` by k mesh units and the tuner space
+    (tune/space.py) only admits stream counts whose 1024^3 staged
+    ladder stays inside the 0.85xHBM budget.
+
+    streams : number of replica meshes (the tuner's ``paint_streams``
+        knob; clamped to [1, s^3] — k=1 degenerates to
+        :func:`paint_local`'s chain).
+    chunk : particles per scatter pass, as in :func:`paint_local`
+        (the replica tuple is the fori_loop carry).
+    """
+    n0l, N1, N2 = (int(x) for x in shape)
+    if period is None:
+        period = shape
+    period = tuple(int(p) for p in period)
+    n = pos.shape[0]
+    s = window_support(resampler)
+    k = max(1, min(int(streams), s ** 3))
+    dtype = out.dtype if out is not None else (
+        mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
+    counter('paint.trace.streams').add(1)
+    counter('paint.trace.streams_particles').add(int(n))
+    gauge('paint.trace.stream_count').set(k)
+    mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
+
+    # data-derived zero: under shard_map the fori_loop carry must have
+    # the same varying-manual-axes type as the per-step update
+    zinit = jnp.zeros((), dtype) + jnp.sum(mass[:1]) * 0
+    flats = [jnp.zeros(n0l * N1 * N2, dtype=dtype) + zinit
+             for _ in range(k)]
+
+    def body(pos_c, mass_c, flats):
+        flats = list(flats)
+        for j, (lin, w) in enumerate(_offset_terms(
+                pos_c, mass_c, resampler, period, origin, n0l)):
+            # round-robin deal: adjacent offsets land on different
+            # replicas, so no chain carries two consecutive streams
+            flats[j % k] = flats[j % k].at[lin].add(w.astype(dtype))
+        return tuple(flats)
+
+    if chunk is None or chunk >= n:
+        flats = body(pos, mass, tuple(flats))
+    else:
+        nchunks = (n + chunk - 1) // chunk
+        npad = nchunks * chunk
+        pos_p = jnp.concatenate(
+            [pos, jnp.zeros((npad - n, 3), pos.dtype)], axis=0)
+        mass_p = jnp.concatenate(
+            [mass, jnp.zeros((npad - n,), dtype)], axis=0)
+        pos_p = pos_p.reshape(nchunks, chunk, 3)
+        mass_p = mass_p.reshape(nchunks, chunk)
+
+        def loop(i, flats):
+            return body(pos_p[i], mass_p[i], flats)
+        flats = jax.lax.fori_loop(0, nchunks, loop, tuple(flats))
+
+    # pairwise tree sum: log2(k) dependent adds instead of k
+    flats = list(flats)
+    while len(flats) > 1:
+        nxt = [a + b for a, b in zip(flats[::2], flats[1::2])]
+        if len(flats) % 2:
+            nxt.append(flats[-1])
+        flats = nxt
+    flat = flats[0]
+    if out is not None:
+        flat = flat + jnp.asarray(out).reshape(-1)
+    return flat.reshape(shape)
+
+
 # ---------------------------------------------------------------------------
 # MXU paint: tile-bucketed batched-matmul deposit
 
@@ -361,19 +542,9 @@ def _bucket_by_argsort(key, n, B, Kcap, order_method='auto'):
     MXU backends, argsort elsewhere). Both are stable, so the slot
     assignment is IDENTICAL — tests/test_radix.py asserts it.
     """
-    if order_method == 'auto':
-        from ..utils import is_mxu_backend
-        order_method = 'radix' if is_mxu_backend() else 'argsort'
-    if order_method == 'radix':
-        from .radix import stable_key_order
-        # alphabet is [0, B] (B = trash bucket)
-        order = stable_key_order(key, B + 1)
-    elif order_method == 'argsort':
-        order = jnp.argsort(key)
-    else:
-        # a typo must not silently measure/record the wrong engine
-        raise ValueError("unknown order_method %r (choose "
-                         "'auto'/'radix'/'argsort')" % (order_method,))
+    from .radix import order_keys
+    # alphabet is [0, B] (B = trash bucket)
+    order = order_keys(key, B + 1, order_method)
     skey = key[order]
     iot = jnp.arange(n, dtype=jnp.int32)
     is_start = jnp.concatenate(

@@ -116,3 +116,25 @@ def deposit_blocks_pallas(txi, sx, sy, sz, sm, *, resampler, rb, cb,
         interpret=interpret,
     )(jnp.asarray(txi, jnp.int32).reshape(1), sx, sy, sz, sm)
     return blk
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_deposit_lowers():
+    """Does the Pallas deposit LOWER on this backend?  A cheap
+    trace+lower of a tiny dummy call (no compile, no execution) — the
+    gate the tuner space (tune/space.py) puts in front of the
+    ``mxu-*-pallas`` candidate so it only competes where Mosaic
+    actually accepts the kernel (e.g. not over a remote-compile tunnel
+    that rejects custom calls).  Cached: one probe per process."""
+    try:
+        z = jnp.zeros((1, 1, 8), jnp.float32)
+
+        def fn(txi, sx, sy, sz, sm):
+            return deposit_blocks_pallas(
+                txi, sx, sy, sz, sm, resampler='cic', rb=2, cb=2,
+                n0l=8, p0=8, N1=8, N2=8, origin=0, dtype=jnp.float32,
+                interpret=False)
+        jax.jit(fn).lower(jnp.int32(0), z, z, z, z)
+        return True
+    except Exception:
+        return False

@@ -120,6 +120,31 @@ def stable_order(key, D):
     return jnp.argsort(key)
 
 
+def order_keys(key, D, method='auto'):
+    """Stable ordering with an EXPLICIT engine choice — the dispatch
+    behind the tuner's ``paint_order`` knob (ops/paint.py bucketing
+    and the one-sort deposit kernels).
+
+    method : 'argsort' (one bitonic lax sort — O(n log^2 n) HBM passes
+        on TPU, the fast native sort on CPU), 'radix'
+        (:func:`stable_key_order` — O(n) counting passes over the
+        [0, D) alphabet, the TPU-shaped choice), or 'auto' (radix on
+        MXU backends, argsort elsewhere). Both engines are stable, so
+        the resulting permutation is identical and the choice is pure
+        performance (tests/test_radix.py asserts the equality).
+    """
+    if method == 'auto':
+        from ..utils import is_mxu_backend
+        method = 'radix' if is_mxu_backend() else 'argsort'
+    if method == 'radix':
+        return stable_key_order(key, D)
+    if method == 'argsort':
+        return jnp.argsort(key)
+    # a typo must not silently measure/record the wrong engine
+    raise ValueError("unknown order method %r (choose "
+                     "'auto'/'radix'/'argsort')" % (method,))
+
+
 def _invert_perm(dest):
     """order[dest[i]] = i (scatter with provably unique indices)."""
     n = dest.shape[0]

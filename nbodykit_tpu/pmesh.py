@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import _global_options
-from .diagnostics import current_tracer, histogram, \
+from .diagnostics import counter, current_tracer, histogram, \
     install_compile_telemetry, span, \
     trace_state_clean
 from .parallel.runtime import AXIS, CurrentMesh, mesh_size, shard_leading
@@ -39,8 +39,9 @@ from .parallel import dfft
 from .parallel.halo import halo_add, halo_fill
 from .parallel.exchange import exchange_by_dest
 from .ops.window import window_support
-from .ops.paint import (paint_local, paint_local_sorted, paint_local_mxu,
-                        readout_local)
+from .ops.paint import (paint_local, paint_local_sorted,
+                        paint_local_segsum, paint_local_streams,
+                        paint_local_mxu, readout_local)
 
 # compile telemetry for the paint/FFT entry points below: XLA compiles
 # and compilation-cache hits/misses land in the metric registry
@@ -301,6 +302,18 @@ class ParticleMesh(object):
         The result is synced (``block_until_ready``) inside the span so
         the throughput is real work, not dispatch — enabled-mode only;
         the disabled path is byte-identical to the undiagnosed one.
+
+        Dropped-deposit contract for ``paint_method='mxu'``: the mxu
+        kernel's slack-sized tile buckets CAN overflow. Eagerly the
+        overflow self-heals — each retry of the slack-backoff ladder
+        first bumps the process-wide ``paint.dropped`` counter and
+        emits a ``paint.dropped`` trace event (count + failing slack),
+        so no loss is silent even though the final mesh is exact.
+        Under a trace the backoff cannot branch, so
+        ``return_dropped=True`` is REQUIRED (enforced above): the
+        traced path's ONLY overflow signal is the returned count —
+        counters and events cannot fire inside jit — and a caller who
+        ignores it has lost deposits with no trace-side record.
         """
         if current_tracer() is None or not trace_state_clean():
             return self._paint_impl(pos, mass, resampler, out, shift,
@@ -367,6 +380,20 @@ class ParticleMesh(object):
                 def kern(*a, **kw):
                     return (paint_local_sorted(*a, **kw),
                             jnp.zeros((), jnp.int32))
+            elif pm_method == 'segsum':
+                order = pcfg['paint_order']
+
+                def kern(*a, **kw):
+                    return (paint_local_segsum(*a, order_method=order,
+                                               **kw),
+                            jnp.zeros((), jnp.int32))
+            elif pm_method == 'streams':
+                nstreams = pcfg['paint_streams']
+
+                def kern(*a, **kw):
+                    return (paint_local_streams(*a, streams=nstreams,
+                                                chunk=chunk, **kw),
+                            jnp.zeros((), jnp.int32))
             elif pm_method == 'mxu':
                 order = pcfg['paint_order']
                 dep = pcfg['paint_deposit']
@@ -391,6 +418,7 @@ class ParticleMesh(object):
             # retry contract (traced callers see the count via
             # return_dropped)
             while not traced and int(over) > 0 and mxu_slack < 1e6:
+                self._note_dropped(int(over), mxu_slack)
                 mxu_slack *= 4
                 self.logger.info(
                     "mxu paint bucket overflow (%d dropped); retrying "
@@ -455,6 +483,7 @@ class ParticleMesh(object):
                     "maximal capacity %d — this should be impossible"
                     % capacity)
         while not traced and int(over) > 0 and mxu_slack < 1e6:
+            self._note_dropped(int(over), mxu_slack)
             mxu_slack *= 4
             self.logger.info(
                 "mxu paint bucket overflow (%d dropped); retrying "
@@ -464,6 +493,20 @@ class ParticleMesh(object):
         if return_dropped:
             return out, dropped + over
         return out
+
+    def _note_dropped(self, count, slack):
+        """Observability of an eager mxu bucket overflow, BEFORE the
+        backoff retry heals it: the ``paint.dropped`` counter carries
+        the would-have-been-lost deposit count across the whole
+        process, and an enabled tracer gets a zero-duration
+        ``paint.dropped`` event with the count and the slack that
+        proved too small — so a post-mortem can see how often the
+        ladder climbed and from where."""
+        counter('paint.dropped').add(int(count))
+        tr = current_tracer()
+        if tr is not None:
+            tr.event('paint.dropped', {'dropped': int(count),
+                                       'slack': float(slack)})
 
     def _check_overflow_contract(self, capacity, traced, return_dropped):
         if traced and capacity is not None and not return_dropped:
@@ -620,7 +663,7 @@ class ParticleMesh(object):
 
 def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
                 paint_method='scatter', paint_chunk=None,
-                hbm_bytes=16e9, exchange='counted',
+                paint_streams=None, hbm_bytes=16e9, exchange='counted',
                 exchange_imbalance=1.5):
     """Estimated peak per-device HBM for the FFTPower pipeline
     (paint -> rFFT -> |delta_k|^2 -> chunked binning) — the arithmetic
@@ -666,6 +709,20 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
         # all s^3 deposit terms live at once: (key i32 + val) pairs,
         # doubled by the sort's out-of-place buffers
         paint_tmp = (s ** 3) * (4 + item) * (npart / ndev) * 2
+    elif paint_method == 'segsum':
+        # same one-sort streams as 'sort', plus the segment_sum's
+        # (n, s^3) totals and gathered run_tot buffers
+        paint_tmp = ((s ** 3) * (4 + item) * (npart / ndev) * 2
+                     + 2 * (s ** 3) * item * (npart / ndev))
+    elif paint_method == 'streams':
+        # k replica meshes (full mesh units each — THE cost of
+        # breaking the scatter chain) next to the live chunk's
+        # deposit terms
+        if paint_streams is None:
+            from .tune.resolve import effective_int_option
+            paint_streams = effective_int_option('paint_streams')
+        k = max(int(paint_streams), 1)
+        paint_tmp = k * real + (s ** 3) * (4 + item) * live
     elif paint_method == 'mxu':
         # padded bucket payload (slack * (pos + mass)), the argsort of
         # the n keys (key + order i32, out-of-place), one x-stripe's

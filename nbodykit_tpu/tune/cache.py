@@ -36,7 +36,8 @@ import numpy as np
 # committed cache is a validation error, not silently applied)
 TUNABLE_OPTIONS = ('paint_method', 'paint_order', 'paint_deposit',
                    'paint_chunk_size', 'paint_bucket_slack',
-                   'fft_chunk_bytes', 'exchange_slack')
+                   'paint_streams', 'fft_chunk_bytes',
+                   'exchange_slack')
 
 STALE_DAYS = 30.0
 

@@ -29,6 +29,7 @@ FALLBACKS = {
     'paint_order': 'auto',          # hardware heuristic (ops/radix.py)
     'paint_deposit': 'xla',
     'paint_chunk_size': 1024 * 1024 * 16,
+    'paint_streams': 4,            # replica meshes of the streams kernel
     'fft_chunk_bytes': 2 ** 31,
     'exchange_slack': 1.05,
 }
@@ -67,7 +68,7 @@ def resolve_paint(nmesh, npart, dtype='f4', nproc=1):
     answered, ``winner_name``."""
     opts = {k: _current(k) for k in
             ('paint_method', 'paint_order', 'paint_deposit',
-             'paint_chunk_size')}
+             'paint_chunk_size', 'paint_streams')}
     # paint_order/'auto' and paint_deposit/'auto' keep their hardware-
     # heuristic meaning unless the METHOD itself asked the tuner:
     # consulting the cache for every default-configured paint would
@@ -87,7 +88,7 @@ def resolve_paint(nmesh, npart, dtype='f4', nproc=1):
         # value — an explicit paint_order/'radix' next to
         # paint_method='auto' stays explicit
         for key in ('paint_method', 'paint_order', 'paint_deposit',
-                    'paint_chunk_size'):
+                    'paint_chunk_size', 'paint_streams'):
             if opts[key] == 'auto':
                 cfg[key] = winner.get(key, FALLBACKS[key])
     # concreteness guarantees: the 'auto' sentinel survives only for
@@ -98,6 +99,10 @@ def resolve_paint(nmesh, npart, dtype='f4', nproc=1):
             not isinstance(cfg['paint_chunk_size'], (int, float)):
         cfg['paint_chunk_size'] = FALLBACKS['paint_chunk_size']
     cfg['paint_chunk_size'] = int(cfg['paint_chunk_size'])
+    if isinstance(cfg['paint_streams'], bool) or \
+            not isinstance(cfg['paint_streams'], (int, float)):
+        cfg['paint_streams'] = FALLBACKS['paint_streams']
+    cfg['paint_streams'] = int(cfg['paint_streams'])
     return cfg
 
 
@@ -170,6 +175,7 @@ def tuned_snapshot(nmesh=None, npart=None, dtype='f4', nproc=1):
         'paint_order': paint['paint_order'],
         'paint_deposit': paint['paint_deposit'],
         'paint_chunk_size': paint['paint_chunk_size'],
+        'paint_streams': paint['paint_streams'],
         'paint_source': paint['source'],
         'fft_chunk_bytes': resolve_fft_chunk_bytes(
             shape=(nmesh,) * 3 if nmesh else None, dtype=dtype,

@@ -11,9 +11,15 @@ The spaces below cover the knobs round 5 proved are regime-dependent
 guesses (VERDICT.md: the hand-picked MXU paint lost to the plain
 scatter on real hardware at every measured scale):
 
-- **paint** — kernel (``scatter`` / ``sort`` / ``mxu``) × scatter
-  chunk size × mxu ordering engine (``radix`` vs ``argsort``) × mxu
-  deposit engine (``xla`` vs ``pallas``, MXU backends only);
+- **paint** — kernel (``scatter`` / ``sort`` / ``segsum`` /
+  ``streams`` / ``mxu``) × scatter chunk size × one-sort ordering
+  engine (``radix`` vs ``argsort``, segsum and mxu) × stream count
+  (``streams``: k ∈ {2, 4, 8}, each admitted only if
+  ``pmesh.memory_plan`` keeps its k replica meshes inside the
+  0.85×HBM budget at the trial shape) × mxu deposit engine (``xla``
+  vs ``pallas`` — MXU backends where the Pallas kernel provably
+  lowers, :func:`~nbodykit_tpu.ops.paint_pallas.
+  pallas_deposit_lowers`);
 - **fft** — the single-device ``fft_chunk_bytes`` dispatch target
   (one-shot in-jit vs slab-chunked vs eager lowmem);
 - **exchange** — the counted-capacity slack of the particle
@@ -110,24 +116,59 @@ def _paint_candidates(ctx):
                                       'paint_chunk_size':
                                       1024 * 1024 * 4}),
         Candidate('sort', {'paint_method': 'sort'}),
+        Candidate('segsum-argsort', {'paint_method': 'segsum',
+                                     'paint_order': 'argsort'}),
+        Candidate('segsum-radix', {'paint_method': 'segsum',
+                                   'paint_order': 'radix'}),
+    ]
+    # offset-stream scatter: k replica meshes are k full mesh units of
+    # HBM, so each stream count must prove — via the same NBK5xx
+    # symbolic-peak model the lint budget gate uses — that the staged
+    # ladder still fits before it may compete. memory_plan is pure
+    # arithmetic with deterministic defaults (ndevices=1, 16 GB HBM),
+    # so the candidate list stays a pure function of ctx.
+    from ..pmesh import memory_plan
+    for k in (2, 4, 8):
+        plan = memory_plan(int(ctx['nmesh']), int(ctx['npart']),
+                           dtype=ctx.get('dtype', 'f4'),
+                           paint_method='streams', paint_streams=k)
+        if plan['fits']:
+            cands.append(Candidate('streams%d' % k,
+                                   {'paint_method': 'streams',
+                                    'paint_streams': k}))
+    cands.extend([
         Candidate('mxu-argsort-xla', {'paint_method': 'mxu',
                                       'paint_order': 'argsort',
                                       'paint_deposit': 'xla'}),
         Candidate('mxu-radix-xla', {'paint_method': 'mxu',
                                     'paint_order': 'radix',
                                     'paint_deposit': 'xla'}),
-    ]
+    ])
     for c in cands:
         c.options.setdefault('paint_chunk_size', chunk)
     if is_mxu_backend():
         # the Pallas VMEM deposit is interpreted (≈100x slow) off-MXU:
-        # off-chip it would only ever lose, so it does not compete there
-        cands.append(Candidate('mxu-radix-pallas',
-                               {'paint_method': 'mxu',
-                                'paint_order': 'radix',
-                                'paint_deposit': 'pallas',
-                                'paint_chunk_size': chunk}))
+        # off-chip it would only ever lose, so it does not compete
+        # there — and even on-MXU it competes only where the kernel
+        # actually LOWERS (a remote-compile tunnel can reject Mosaic
+        # custom calls; the probe is a cached trace+lower, no compile)
+        from ..ops.paint_pallas import pallas_deposit_lowers
+        if pallas_deposit_lowers():
+            cands.append(Candidate('mxu-radix-pallas',
+                                   {'paint_method': 'mxu',
+                                    'paint_order': 'radix',
+                                    'paint_deposit': 'pallas',
+                                    'paint_chunk_size': chunk}))
     return cands
+
+
+def registered_paint_candidates(nmesh, npart, dtype='f4'):
+    """The paint candidate list for a shape, as the tuner would build
+    it — the enumeration bench.py ``--paint-all``, the smoke gate and
+    tests/test_paint_kernels.py iterate so 'every registered
+    candidate' means exactly the competitors of a real trial."""
+    return _paint_candidates({'nmesh': int(nmesh), 'npart': int(npart),
+                              'dtype': dtype})
 
 
 def _paint_runner(ctx):
@@ -146,7 +187,7 @@ def _paint_runner(ctx):
 def paint_space():
     return SearchSpace('paint',
                        ('paint_method', 'paint_order', 'paint_deposit',
-                        'paint_chunk_size'),
+                        'paint_chunk_size', 'paint_streams'),
                        _paint_candidates, _paint_runner)
 
 

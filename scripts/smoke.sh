@@ -80,6 +80,27 @@ echo "== tune: dry-run plan + cache validation gate =="
 python -m nbodykit_tpu.tune --dry-run --devices 8 > /dev/null
 python -m nbodykit_tpu.tune --validate
 
+# paint candidate gate (docs/PERF.md): every registered paint
+# candidate at a bounded CPU shape (mesh128/1e5, 2 reps) must lower,
+# run and deposit finite mass — CI catches a candidate that stops
+# lowering before a hardware window wastes its budget on it. Bench
+# stdout may carry setup noise, so only the last line is parsed.
+echo "== paint candidate gate (mesh128/1e5, all candidates) =="
+python bench.py --paint-all 128 100000 2 | python -c '
+import json, math, sys
+recs = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert recs, "no paint candidates registered"
+bad = {n: r["error"] for n, r in recs.items() if "error" in r}
+assert not bad, "candidates raised: %r" % bad
+for name, rec in sorted(recs.items()):
+    assert rec["value"] > 0, (name, rec)
+    assert math.isfinite(rec["mass_sum"]) and rec["mass_sum"] > 0, \
+        (name, rec["mass_sum"])
+print("paint gate OK: " + "  ".join(
+    "%s=%.3fs" % (n, r["value"])
+    for n, r in sorted(recs.items(), key=lambda kv: kv[1]["value"])))
+'
+
 # fault-injected resume smoke (docs/RESILIENCE.md): a 2-rep CPU bench
 # is SIGKILLed entering rep 2 by the fault harness, then relaunched —
 # the relaunch must resume from the checkpoint and flush one complete
@@ -119,6 +140,7 @@ python -m pytest \
     tests/test_lint_dataflow.py \
     tests/test_jax_compat.py \
     tests/test_pmesh.py \
+    tests/test_paint_kernels.py \
     tests/test_fftpower.py \
     tests/test_counted_exchange.py \
     tests/test_radix.py \

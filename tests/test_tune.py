@@ -183,6 +183,12 @@ def test_trial_plan_deterministic():
     assert p1[0]['key'] == 'cpu|cpu|8|paint|mesh64-part1e4|float32'
     assert 'scatter' in p1[0]['candidates']
     assert 'sort' in p1[0]['candidates']
+    # the ISSUE 8 kernel families compete deterministically: both
+    # segsum orders, and every stream count memory_plan admits at
+    # this shape (all of {2,4,8} at mesh64/1e4)
+    for name in ('segsum-argsort', 'segsum-radix',
+                 'streams2', 'streams4', 'streams8'):
+        assert name in p1[0]['candidates']
 
 
 def _tiny_paint_space():
@@ -254,6 +260,8 @@ def test_auto_cold_cache_zero_trials(tmp_path):
     cfg = resolve_paint(nmesh=16, npart=500, nproc=1)
     assert cfg['paint_method'] == 'scatter'
     assert cfg['source'] == 'default'
+    # the new knob resolves to its concrete fallback on a cold cache
+    assert cfg['paint_streams'] == 4
     assert resolve_fft_chunk_bytes(shape=(16, 16, 16)) == 2 ** 31
     # resolution NEVER runs trials: cold cache == today's defaults
     assert _counter('tune.trials') == 0
@@ -436,6 +444,13 @@ def test_cli_dry_run_is_deterministic(tmp_path, capsys):
     ops = [p['op'] for p in out1['plan']]
     assert ops.count('paint') == 2 and 'fft' in ops
     assert all('|' in p['key'] for p in out1['plan'])
+    # every paint plan carries the stream/segsum families (the CLI's
+    # default shapes are small enough for all stream counts to fit)
+    for p in out1['plan']:
+        if p['op'] == 'paint':
+            for name in ('segsum-argsort', 'segsum-radix',
+                         'streams2', 'streams4', 'streams8'):
+                assert name in p['candidates']
     # dry-run touches nothing: no cache file, no trials
     assert not os.path.exists(str(tmp_path / 'TC.json'))
     assert _counter('tune.trials') == 0
