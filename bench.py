@@ -52,6 +52,13 @@ Subcommands (internal):
     bench.py --worker                 run the full ladder (imports jax)
     bench.py --config N NPART [m]     one fftpower config, JSON on stdout
     bench.py --paint N NPART          paint-only microbench
+    bench.py --fft-decomp-compare N [reps]
+                                      slab-vs-pencil distributed rFFT
+                                      on the multi-device mesh
+
+Global flags (any subcommand): --fft-decomp {slab,pencil,auto} and
+--pencil PXxPY override the FFT decomposition for the run; the
+record's tuned:{...} stamps what actually resolved.
 """
 
 import json
@@ -94,6 +101,36 @@ TPU_PLATFORMS = ('tpu', 'axon')
 # v5e single-chip nominals for efficiency estimates
 V5E_HBM_GBPS = 819.0
 
+# global FFT decomposition overrides (--fft-decomp / --pencil), staged
+# here by _parse_fft_flags and applied by _setup_jax once jax is up;
+# every record's tuned:{...} then stamps the decomposition and device-
+# mesh shape the measurement actually ran with (tuned_snapshot)
+_FFT_OPTS = {}
+
+
+def _parse_fft_flags(argv):
+    """Strip the global ``--fft-decomp slab|pencil|auto`` and
+    ``--pencil PXxPY`` flags from an argv list (any subcommand may
+    carry them) and stage the overrides for :func:`_setup_jax`."""
+    out = []
+    it = iter(argv)
+    for a in it:
+        if a == '--fft-decomp':
+            _FFT_OPTS['fft_decomp'] = next(it)
+        elif a.startswith('--fft-decomp='):
+            _FFT_OPTS['fft_decomp'] = a.split('=', 1)[1]
+        elif a == '--pencil':
+            _FFT_OPTS['fft_pencil'] = next(it)
+        elif a.startswith('--pencil='):
+            _FFT_OPTS['fft_pencil'] = a.split('=', 1)[1]
+        else:
+            out.append(a)
+    if _FFT_OPTS.get('fft_decomp') not in (None, 'slab', 'pencil',
+                                           'auto'):
+        raise SystemExit('--fft-decomp must be slab, pencil or auto '
+                         '(got %r)' % _FFT_OPTS['fft_decomp'])
+    return out
+
 
 def _utcnow():
     return time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())
@@ -135,6 +172,9 @@ def _setup_jax():
         # readable (python -m nbodykit_tpu.diagnostics --report ...)
         import nbodykit_tpu
         nbodykit_tpu.set_options(diagnostics=TRACE_DIR)
+    if _FFT_OPTS:
+        import nbodykit_tpu
+        nbodykit_tpu.set_options(**_FFT_OPTS)
     return jax
 
 
@@ -886,6 +926,73 @@ def run_fftbw(Nmesh=512, reps=3):
     return _stamp(rec)
 
 
+def run_fft_decomp(Nmesh=256, reps=3):
+    """Slab-vs-pencil distributed rFFT on the process-visible
+    multi-device mesh: the same ``pm.r2c`` program the tuner races
+    (tune/space.py fft space), timed under both decompositions so the
+    committed round files carry the knob's trajectory.  Needs >= 2
+    devices (CPU: JAX_NUM_CPU_DEVICES=8); ``--pencil PXxPY`` picks the
+    factorization, else the near-square default."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import nbodykit_tpu
+    from nbodykit_tpu.parallel.runtime import (cpu_mesh,
+                                               default_pencil_factor,
+                                               mesh_size, tpu_mesh,
+                                               use_mesh)
+    from nbodykit_tpu.utils import is_mxu_backend
+    mesh = tpu_mesh() if is_mxu_backend() else cpu_mesh()
+    nproc = mesh_size(mesh)
+    rec = {"metric": "fftdecomp_nmesh%d" % Nmesh, "unit": "s",
+           "platform": jax.devices()[0].platform, "nmesh": Nmesh,
+           "nproc": nproc}
+    if nproc < 2:
+        rec['error'] = ('fft decomp compare needs a multi-device mesh '
+                        '(nproc=%d; on CPU set JAX_NUM_CPU_DEVICES)'
+                        % nproc)
+        return _stamp(rec)
+    pencil = _FFT_OPTS.get('fft_pencil')
+    if pencil:
+        px, _, py = str(pencil).lower().partition('x')
+        pxpy = (int(px), int(py))
+        if pxpy[0] * pxpy[1] != nproc:
+            raise SystemExit('--pencil %s does not cover %d devices'
+                             % (pencil, nproc))
+    else:
+        pxpy = default_pencil_factor(nproc)
+    rec['pencil'] = '%dx%d' % pxpy
+    from nbodykit_tpu.pmesh import ParticleMesh
+    with use_mesh(mesh):
+        pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
+        x = jax.random.uniform(jax.random.key(7), pm.shape_real,
+                               jnp.float32)
+        x = jax.device_put(x, pm.sharding())
+        _sync(jax, x)
+
+        def timed():
+            _sync(jax, pm.r2c(x))           # warm (compile) rep
+            t0 = time.time()
+            for _ in range(reps):
+                _sync(jax, pm.r2c(x))
+            return (time.time() - t0) / reps
+
+        for name, opts in (('slab', {'fft_decomp': 'slab'}),
+                           ('pencil', {'fft_decomp': 'pencil',
+                                       'fft_pencil':
+                                       '%dx%d' % pxpy})):
+            with nbodykit_tpu.set_options(**opts):
+                rec['%s_s' % name] = round(timed(), 4)
+        from nbodykit_tpu.tune.resolve import tuned_snapshot
+        rec['tuned'] = tuned_snapshot(nmesh=Nmesh, npart=0, dtype='f4',
+                                      nproc=nproc)
+    rec['value'] = min(rec['slab_s'], rec['pencil_s'])
+    rec['winner'] = ('slab' if rec['slab_s'] <= rec['pencil_s']
+                     else 'pencil')
+    rec['pencil_speedup'] = round(rec['slab_s']
+                                  / max(rec['pencil_s'], 1e-9), 3)
+    return _stamp(rec)
+
+
 def _paint_method_options(method, Nmesh, Npart):
     """``set_options`` kwargs selecting one paint configuration by
     name.
@@ -1452,7 +1559,7 @@ def main():
 
 
 if __name__ == '__main__':
-    argv = sys.argv[1:]
+    argv = _parse_fft_flags(sys.argv[1:])
     if not argv:
         sys.exit(main())
     if argv[0] == '--worker':
@@ -1468,6 +1575,11 @@ if __name__ == '__main__':
         sys.exit(0)
     if argv[0] == '--fftbw':
         print(json.dumps(run_fftbw(int(argv[1]) if argv[1:] else 512)))
+        sys.exit(0)
+    if argv[0] == '--fft-decomp-compare':
+        print(json.dumps(run_fft_decomp(
+            int(argv[1]) if argv[1:] else 256,
+            reps=int(argv[2]) if argv[2:] else 3)))
         sys.exit(0)
     if argv[0] == '--prim':
         print(json.dumps(run_prim(int(argv[1]) if argv[1:]

@@ -69,6 +69,17 @@ _default_options = {
     # 0 disables chunking; 'auto' consults the tune cache
     # (nbodykit_tpu.tune) and falls back to 2**31 when cold.
     'fft_chunk_bytes': 2 ** 31,
+    # distributed-FFT decomposition: 'slab' (1-D mesh, one P-way
+    # all_to_all), 'pencil' (2-D Mesh(('x','y')), two smaller
+    # transposes — inner over ICI, outer over DCN; parallel/dfft.py) or
+    # 'auto' (the measured winner from the tune cache, keyed by device
+    # count AND (Px, Py) factorization; cold cache falls back to
+    # 'slab' at zero trial cost)
+    'fft_decomp': 'slab',
+    # explicit (Px, Py) factorization for the pencil path, as 'PXxPY'
+    # (e.g. '4x2') or a tuple; None picks the most nearly square
+    # factorization of the device count (runtime.default_pencil_factor)
+    'fft_pencil': None,
     # performance-database file for 'auto' option resolution and
     # nbodykit-tpu-tune (nbodykit_tpu.tune, docs/TUNE.md). None uses
     # the committed repo-root TUNE_CACHE.json; seeded from
@@ -179,6 +190,17 @@ class set_options(object):
         single-device FFTs with complex output larger than this run as
         slab-chunked per-axis passes (0 disables); 'auto' consults the
         tune cache, falling back to 2**31 when cold.
+    fft_decomp : str
+        distributed-FFT decomposition: 'slab' (one P-way all_to_all
+        over the 1-D mesh), 'pencil' (two smaller transposes over a
+        2-D ``Mesh(('x','y'))`` — see parallel/dfft.py and
+        docs/PERF.md "Slab vs pencil"), or 'auto' (the tune-cache
+        winner for this platform, device count and (Px, Py)
+        factorization; a cold cache resolves to 'slab').
+    fft_pencil : str, tuple or None
+        explicit (Px, Py) device factorization for the pencil path
+        ('4x2' or ``(4, 2)``); None picks the most nearly square
+        factorization of the device count.
     tune_cache : str or None
         path of the performance database consulted by 'auto' options
         and written by ``nbodykit-tpu-tune``; None (the default) uses

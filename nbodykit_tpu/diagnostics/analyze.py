@@ -37,8 +37,12 @@ from .trace import read_trace, trace_files
 # span names treated as cross-process sync points (k-th occurrence of
 # each is matched across pids).  'barrier' is the explicit anchor the
 # multi-host workers emit; the rest are the collective hot paths.
+# The two pencil-FFT transposes anchor separately so a straggler table
+# splits inner (within a 'y' group, ICI on a hybrid mesh) from outer
+# (across 'x' groups, DCN) all_to_all time.
 DEFAULT_ANCHORS = ('barrier', 'exchange', 'fft.r2c', 'fft.c2r',
-                   'fft.c2c', 'runtime.init_distributed')
+                   'fft.c2c', 'fft.a2a.inner', 'fft.a2a.outer',
+                   'runtime.init_distributed')
 
 # span name -> critical-path phase
 _PHASE_PREFIXES = (

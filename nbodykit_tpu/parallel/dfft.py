@@ -1,4 +1,5 @@
-"""Distributed 3-D real-to-complex FFT over a 1-D device mesh.
+"""Distributed 3-D real-to-complex FFT over a 1-D (slab) or 2-D
+(pencil) device mesh.
 
 This replaces the reference's pfft/pmesh slab-decomposed MPI FFT (consumed
 at nbodykit/base/mesh.py:296-304 via ``RealField.r2c``). The design is the
@@ -28,6 +29,32 @@ inside one jitted graph so XLA fuses the surrounding elementwise work
 Hermitian compression comes for free from rfft (last axis length N2//2+1);
 the double-count weights for the missing half-plane are handled at binning
 time (see meshtools.py, mirroring reference nbodykit/meshtools.py:188-215).
+
+Pencil (2-D) decomposition
+--------------------------
+The slab algorithm caps useful parallelism at N0 slabs and pays ONE
+P-way all_to_all moving the whole N³ field across the fleet. On a 2-D
+``Mesh(('x', 'y'))`` of shape (Px, Py) the field is decomposed into
+(N0/Px, N1/Py, N2) *pencils* and the transpose splits in two:
+
+  r2c:  (N0/Px, N1/Py, N2) --rfft ax2--> (., ., Nc) --pad z to %Py-->
+        --a2a over 'y' (split ax2, concat ax1)--> (N0/Px, N1, Ncp/Py)
+                          --fft  ax1-->
+        --a2a over 'x' (split ax1, concat ax0)--> (N0, N1/Px, Ncp/Py)
+                          --fft  ax0--> --transpose--> (N1/Px, N0, .)
+
+The inner a2a stays within a 'y' group (ICI on a hybrid mesh built by
+:func:`..runtime.pencil_mesh`); the outer a2a crosses 'x' groups (DCN
+across slices). Each moves the field once among only Py (resp. Px)
+peers, vs the slab's single P-way exchange — see docs/PERF.md "Slab vs
+pencil" for the communication-volume model. The Hermitian-compressed z
+axis (Nc = N2//2+1) is zero-padded to a multiple of Py before the inner
+transpose; the pad columns stay exactly zero through the remaining
+(linear) stages and are sliced off the output. Output layout and
+normalization are identical to the slab path, so the two decompositions
+are interchangeable per call. Selection is a tuned knob
+(``set_options(fft_decomp='slab'|'pencil'|'auto')``) resolved at
+dispatch in :class:`dist_fft_plan`.
 """
 
 import time as _time
@@ -35,9 +62,10 @@ from functools import lru_cache as _lru_cache
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from .runtime import AXIS, mesh_size
+from .runtime import AXIS, AXIS_X, AXIS_Y, default_pencil_factor, \
+    is_pencil, mesh_size, pencil_mesh
 from ..diagnostics import counter, current_tracer, histogram, \
     install_compile_telemetry, instrumented_jit, span, span_if
 
@@ -47,19 +75,23 @@ from ..diagnostics import counter, current_tracer, histogram, \
 install_compile_telemetry()
 
 
-def _fft_chunk_bytes(shape=None, dtype=None):
+def _fft_chunk_bytes(shape=None, dtype=None, mesh_shape=None):
     """The effective chunking target.  An integer option is used
     verbatim; ``'auto'`` resolves through the tune cache
     (nbodykit_tpu.tune — the measured winner for the nearest mesh
     class on this platform, else the 2**31 default at zero trial
     cost).  ``shape``/``dtype`` of the field being transformed sharpen
-    the cache lookup when the caller has them."""
+    the cache lookup when the caller has them; ``mesh_shape`` is the
+    (Px, Py) pencil factorization when one is in play, so a winner
+    measured on a 4x2 mesh is never replayed onto 8x1 (the shape-class
+    key includes the factorization — see tune/cache.py)."""
     from .. import _global_options
     v = _global_options['fft_chunk_bytes']
     if not isinstance(v, bool) and isinstance(v, (int, float)):
         return int(v)
     from ..tune.resolve import resolve_fft_chunk_bytes
-    return resolve_fft_chunk_bytes(shape=shape, dtype=dtype or 'f4')
+    return resolve_fft_chunk_bytes(shape=shape, dtype=dtype or 'f4',
+                                   mesh_shape=mesh_shape)
 
 
 def _lowmem_step(emit, upd, slab, buf, arr, k, r, stage):
@@ -79,8 +111,11 @@ def _lowmem_step(emit, upd, slab, buf, arr, k, r, stage):
 
 
 def _chunk_rows(n, bytes_per_row, target):
-    """Largest divisor of ``n`` whose slab stays under ``target`` bytes."""
-    r = max(1, min(n, int(target // max(bytes_per_row, 1))))
+    """Largest divisor of ``n`` whose slab stays under ``target`` bytes.
+
+    All-integer arithmetic (callers concretize ``target`` at the
+    program-cache boundary): shapes stay static under trace."""
+    r = max(1, min(n, target // max(bytes_per_row, 1)))
     while n % r:
         r -= 1
     return r
@@ -455,6 +490,220 @@ def _irfftn_single_chunked(y, Nmesh2, norm, target):
     return jax.lax.fori_loop(0, N0 // r0, body_b, out)
 
 
+# --------------------------------------------------------------------
+# pencil (2-D) decomposition
+# --------------------------------------------------------------------
+
+#: the eager pencil path's documented peak: at most this many padded
+#: complex pencil units live per device at once — stage 1's output and
+#: stage 2's output, with stage 2 DONATING stage 1's intermediate
+#: (``_pencil_programs`` j2).  ``pmesh.memory_plan`` prices the branch
+#: with exactly this count and the smoke gate asserts it at 1024^3.
+PENCIL_BUFFERS = 2
+
+
+def _pencil_shape(mesh):
+    """(Px, Py) of a 2-D pencil mesh."""
+    return int(mesh.shape[AXIS_X]), int(mesh.shape[AXIS_Y])
+
+
+def _pencil_divisible(N0, N1, px, py):
+    """Whether (N0, N1) decomposes into (Px, Py) pencils: the input
+    spec needs N0 % Px == 0 and N1 % Py == 0, and the outer transpose
+    splits the (full) y axis Px ways. The z axis carries no constraint
+    — it is zero-padded to a multiple of Py before the inner a2a."""
+    return N0 % px == 0 and N1 % py == 0 and N1 % px == 0
+
+
+def _fft_chunked(a, axis, norm, target, inverse=False):
+    """c2c FFT along ``axis`` of a local pencil block, fori_loop-chunked
+    over the other leading axis when the block exceeds the lowmem chunk
+    target — the slab drivers' chunking idiom applied per pencil, so no
+    single FFT op ever spans a multi-GB buffer inside the shard_map."""
+    fn = jnp.fft.ifft if inverse else jnp.fft.fft
+    ch = 1 if axis == 0 else 0
+    n = a.shape[ch]
+    r = _chunk_rows(n, max(a.size * a.dtype.itemsize // max(n, 1), 1),
+                    max(target // 4, 1))
+    if r >= n:
+        return fn(a, axis=axis, norm=norm)
+    counter('fft.trace.chunks').add(n // r)
+    out = jnp.zeros(a.shape, a.dtype)
+    sizes = list(a.shape)
+    sizes[ch] = r
+
+    def body(k, out):
+        start = [0] * a.ndim
+        start[ch] = k * r
+        sl = jax.lax.dynamic_slice(a, tuple(start), tuple(sizes))
+        return jax.lax.dynamic_update_slice(
+            out, fn(sl, axis=axis, norm=norm), tuple(start))
+
+    return jax.lax.fori_loop(0, n // r, body, out)
+
+
+@_lru_cache(maxsize=32)
+def _pencil_programs(mesh, shape, dtype_str, norm, kind, target,
+                     n_out=None):
+    """The two stage programs of one pencil transform, cached per
+    (mesh, shape, dtype, norm, kind).
+
+    ``kind`` is 'r2c', 'c2r', 'c2c' or 'ic2c'. Returns
+    (stage1, stage2, jit1, jit2, pad): ``stage1``/``stage2`` are the
+    raw shard_map callables (composable under an outer trace), and
+    ``jit1``/``jit2`` their jitted forms for the eager path — ``jit2``
+    donates its input so the stage-1 intermediate is aliased into the
+    output and the peak stays at ~2 buffers per pencil (the lowmem
+    donated-buffer idiom; nbkl's NBK5xx model prices this in
+    ``pmesh.memory_plan(fft_decomp='pencil')``).
+    """
+    px, py = _pencil_shape(mesh)
+    fwd = kind in ('r2c', 'c2c')
+    inv = not fwd
+    if fwd:
+        N0, N1, N2 = shape
+    else:
+        N1, N0, NZ = shape  # transposed complex layout in
+    if kind == 'r2c':
+        Nz = N2 // 2 + 1  # Hermitian-compressed z length
+    elif kind == 'c2r':
+        Nz = NZ
+    elif kind == 'c2c':
+        Nz = N2
+    else:  # ic2c
+        Nz = NZ
+    pad = -Nz % py
+    Nzp = Nz + pad
+    if kind == 'r2c':
+        cdt = jnp.complex64 if jnp.dtype(dtype_str).itemsize <= 4 \
+            else jnp.complex128
+    else:
+        cdt = jnp.result_type(jnp.dtype(dtype_str), jnp.complex64)
+
+    if fwd:
+        def stage1(xl):
+            # z-pencils (N0/Px, N1/Py, N2|Nz): transform z while it is
+            # whole, pad to %Py, then the INNER transpose (z <-> y
+            # within a 'y' group) and the y-axis transform
+            if kind == 'r2c':
+                y = jnp.fft.rfft(xl, axis=2, norm=norm).astype(cdt)
+            else:
+                y = _fft_chunked(xl.astype(cdt), 2, norm, target)
+            if pad:
+                y = jnp.pad(y, ((0, 0), (0, 0), (0, pad)))
+            y = jax.lax.all_to_all(y, AXIS_Y, split_axis=2,
+                                   concat_axis=1, tiled=True)
+            return _fft_chunked(y, 1, norm, target)
+
+        def stage2(yl):
+            # y-pencils (N0/Px, N1, Nzp/Py): the OUTER transpose
+            # (y <-> x across 'x' groups), the x-axis transform, and
+            # the transposed (ky-leading) output layout
+            y = jax.lax.all_to_all(yl, AXIS_X, split_axis=1,
+                                   concat_axis=0, tiled=True)
+            y = _fft_chunked(y, 0, norm, target)
+            return jnp.transpose(y, (1, 0, 2))
+
+        in1, out1 = P(AXIS_X, AXIS_Y, None), P(AXIS_X, None, AXIS_Y)
+        in2, out2 = out1, P(AXIS_X, None, AXIS_Y)
+    else:
+        def stage1(yl):
+            # transposed x-pencils (N1/Px, N0, Nzp/Py): undo the x-axis
+            # transform, then the OUTER transpose back
+            z = jnp.transpose(yl, (1, 0, 2))
+            z = _fft_chunked(z, 0, norm, target, inverse=True)
+            z = jax.lax.all_to_all(z, AXIS_X, split_axis=0,
+                                   concat_axis=1, tiled=True)
+            return _fft_chunked(z, 1, norm, target, inverse=True)
+
+        def stage2(zl):
+            # y-pencils (N0/Px, N1, Nzp/Py): the INNER transpose back
+            # (z whole again), drop the pad locally, undo the z-axis
+            # transform
+            z = jax.lax.all_to_all(zl, AXIS_Y, split_axis=1,
+                                   concat_axis=2, tiled=True)
+            if pad:
+                z = z[:, :, :Nz]
+            if kind == 'c2r':
+                return jnp.fft.irfft(z, n=int(n_out), axis=2,
+                                     norm=norm)
+            return _fft_chunked(z, 2, norm, target, inverse=True)
+
+        in1, out1 = P(AXIS_X, None, AXIS_Y), P(AXIS_X, None, AXIS_Y)
+        in2, out2 = out1, P(AXIS_X, AXIS_Y, None)
+
+    s1 = jax.shard_map(stage1, mesh=mesh, in_specs=in1, out_specs=out1)
+    s2 = jax.shard_map(stage2, mesh=mesh, in_specs=in2, out_specs=out2)
+    label = 'fft.pencil.%s' % kind
+    j1 = instrumented_jit(s1, label=label + '.inner')
+    j2 = instrumented_jit(s2, label=label + '.outer',
+                          donate_argnums=(0,))
+    return s1, s2, j1, j2, pad
+
+
+def _pencil_run(x, mesh, norm, kind, Nz_out=None):
+    """Run one pencil transform as its two stages. Eagerly each stage
+    dispatches as a separate jitted program wrapped in a span —
+    ``fft.a2a.inner`` / ``fft.a2a.outer`` — so diagnostics/analyze.py
+    attributes ICI (inner, within a 'y' group) and DCN (outer, across
+    'x' groups) transpose time separately; stage 2 donates the stage-1
+    intermediate. Under an outer trace the raw shard_map stages compose
+    into the caller's graph (donation and spans are the trace's
+    concern there)."""
+    px, py = _pencil_shape(mesh)
+    target = _fft_chunk_bytes(x.shape, x.dtype, mesh_shape=(px, py)) \
+        or 2 ** 31
+    s1, s2, j1, j2, pad = _pencil_programs(
+        mesh, tuple(int(n) for n in x.shape), str(x.dtype), norm, kind,
+        int(target), None if Nz_out is None else int(Nz_out))
+    eager = not isinstance(x, jax.core.Tracer)
+    if kind in ('c2r', 'ic2c') and pad:
+        # the complex input's z axis is padded back to the transform's
+        # internal %Py multiple; the pad columns are zeros and are
+        # dropped locally after the inner transpose
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+    with span_if(eager, 'fft.a2a.inner', kind=kind, group=py,
+                 pencil=[px, py]):
+        mid = (j1 if eager else s1)(x)
+    del x
+    with span_if(eager, 'fft.a2a.outer', kind=kind, group=px,
+                 pencil=[px, py]):
+        out = (j2 if eager else s2)(mid)
+    if kind in ('r2c', 'c2c') and pad:
+        # the forward output carries zero pad columns on the z axis
+        # (they lived on the last 'y' rank); slice back to the
+        # contract's Nc | N2 length
+        out = out[:, :, :out.shape[2] - pad]
+    return out
+
+
+def _pencil_fallback_mesh(mesh, N0, N1):
+    """For shapes that do not factor into (Px, Py) pencils: the slab
+    view of the same devices when the slab constraint holds, else None
+    (single-device semantics — GSPMD gathers)."""
+    n = mesh_size(mesh)
+    if N0 % n == 0 and N1 % n == 0:
+        return Mesh(mesh.devices.reshape(-1), (AXIS,))
+    return None
+
+
+def _pencil_dispatch(x, mesh, kind, run, fallback):
+    """Dispatch a transform on a 2-D mesh: the pencil path when the
+    shape factors, else the slab path over the flattened device order,
+    else single-device semantics. ``fallback(mesh_or_none)`` reruns
+    the caller's impl; ragged shapes therefore stay exact rather than
+    zero-padded (padding would change the transform)."""
+    px, py = _pencil_shape(mesh)
+    if kind in ('r2c', 'c2c'):
+        N0, N1 = int(x.shape[0]), int(x.shape[1])
+    else:
+        N1, N0 = int(x.shape[0]), int(x.shape[1])
+    if _pencil_divisible(N0, N1, px, py):
+        return run()
+    counter('fft.pencil.fallback').add(1)
+    return fallback(_pencil_fallback_mesh(mesh, N0, N1))
+
+
 def dist_rfftn(x, mesh=None, norm=None):
     """3-D rFFT of a slab-sharded real field; returns the transposed-layout
     complex field (see module docstring).
@@ -486,6 +735,11 @@ def dist_rfftn(x, mesh=None, norm=None):
 
 def _dist_rfftn_impl(x, mesh, norm):
     nproc = mesh_size(mesh)
+    if is_pencil(mesh) and nproc > 1:
+        return _pencil_dispatch(
+            x, mesh, 'r2c',
+            lambda: _pencil_run(x, mesh, norm, 'r2c'),
+            lambda m: _dist_rfftn_impl(x, m, norm))
     if nproc == 1:
         N0, N1, N2 = x.shape
         target = _fft_chunk_bytes(x.shape, x.dtype)
@@ -546,6 +800,11 @@ def dist_irfftn(y, Nmesh2, mesh=None, norm=None):
 
 def _dist_irfftn_impl(y, Nmesh2, mesh, norm):
     nproc = mesh_size(mesh)
+    if is_pencil(mesh) and nproc > 1:
+        return _pencil_dispatch(
+            y, mesh, 'c2r',
+            lambda: _pencil_run(y, mesh, norm, 'c2r', Nz_out=Nmesh2),
+            lambda m: _dist_irfftn_impl(y, Nmesh2, m, norm))
     if nproc == 1:
         target = _fft_chunk_bytes(y.shape, y.dtype)
         if target and y.nbytes > target:
@@ -646,6 +905,12 @@ def dist_fftn_c2c(x, mesh=None, inverse=False, norm=None):
 def _dist_fftn_c2c_impl(x, mesh, inverse, norm):
     nproc = mesh_size(mesh)
     fft = jnp.fft.ifft if inverse else jnp.fft.fft
+    if is_pencil(mesh) and nproc > 1:
+        kind = 'ic2c' if inverse else 'c2c'
+        return _pencil_dispatch(
+            x, mesh, kind,
+            lambda: _pencil_run(x, mesh, norm, kind),
+            lambda m: _dist_fftn_c2c_impl(x, m, inverse, norm))
     if nproc == 1:
         target = _fft_chunk_bytes(x.shape, x.dtype)
         if target and x.nbytes > target:
@@ -685,16 +950,103 @@ def _dist_fftn_c2c_impl(x, mesh, inverse, norm):
         out_specs=P(AXIS, None, None))(x)
 
 
+def _parse_pencil(v):
+    """Parse an fft_pencil option value: 'PXxPY', (px, py) or None."""
+    if v in (None, '', 'auto'):
+        return None
+    if isinstance(v, str):
+        px, _, py = v.lower().partition('x')
+        return int(px), int(py)
+    px, py = v
+    return int(px), int(py)
+
+
+def resolve_decomp(nproc, shape=None, dtype=None, decomp=None,
+                   pencil=None):
+    """Resolve the fft_decomp knob to ('slab'|'pencil', (Px, Py)).
+
+    Explicit arguments win over ``set_options(fft_decomp=...)`` /
+    ``set_options(fft_pencil=...)``; ``'auto'`` consults the tune cache
+    for this platform's measured winner at the factorization that WOULD
+    run (so a winner measured on 4x2 never steers an 8x1 request —
+    the shape class carries the factorization), falling back to 'slab'
+    on a cold cache. Returns ('slab', None) for nproc <= 1.
+    """
+    if nproc <= 1:
+        return 'slab', None
+    from .. import _global_options
+    opts = _global_options.copy()
+    decomp = decomp or opts.get('fft_decomp', 'slab')
+    pxpy = _parse_pencil(
+        pencil if pencil is not None else opts.get('fft_pencil'))
+    if pxpy is None:
+        pxpy = default_pencil_factor(nproc)
+    if pxpy[0] * pxpy[1] != nproc:
+        raise ValueError(
+            "fft_pencil %dx%d does not cover %d devices" %
+            (pxpy[0], pxpy[1], nproc))
+    if decomp == 'auto':
+        from ..tune.resolve import resolve_fft_decomp
+        decomp, won = resolve_fft_decomp(
+            shape=shape, dtype=dtype or 'f4', nproc=nproc,
+            mesh_shape=pxpy)
+        pxpy = won or pxpy
+    if decomp not in ('slab', 'pencil'):
+        raise ValueError("fft_decomp must be 'slab', 'pencil' or "
+                         "'auto', got %r" % (decomp,))
+    return decomp, pxpy
+
+
 class dist_fft_plan(object):
     """A small plan object bundling mesh + shape, so call sites read like
-    the reference's ``field.r2c()`` / ``field.c2r()``."""
+    the reference's ``field.r2c()`` / ``field.c2r()``.
 
-    def __init__(self, Nmesh, mesh=None):
+    The slab-vs-pencil decomposition is resolved *at dispatch*, per
+    call: ``set_options(fft_decomp='pencil')`` (or ``'auto'`` once the
+    tuner has measured this platform) reroutes the next transform
+    through the 2-D pencil path with no plan rebuild. An explicit 2-D
+    mesh handed to the plan wins outright; a 1-D mesh is viewed as its
+    (Px, Py) pencil factorization on demand (same devices, row-major
+    order, so slab- and pencil-sharded fields interconvert without
+    data movement).
+    """
+
+    def __init__(self, Nmesh, mesh=None, decomp=None, pencil=None):
         self.Nmesh = tuple(int(n) for n in Nmesh)
         self.mesh = mesh
+        self._decomp = decomp    # explicit override ('slab'|'pencil'|'auto')
+        self._pencil = pencil    # explicit (Px, Py) or 'PXxPY' override
+        self._pencil_cache = {}  # (Px, Py) -> 2-D mesh view
+
+    def _dispatch_mesh(self, shape, dtype):
+        """The mesh the next transform runs on, after resolving the
+        fft_decomp knob (see :func:`resolve_decomp`)."""
+        mesh = self.mesh
+        if mesh is None or is_pencil(mesh):
+            return mesh
+        nproc = mesh_size(mesh)
+        if nproc == 1:
+            return mesh
+        decomp, pxpy = resolve_decomp(
+            nproc, shape=shape, dtype=dtype,
+            decomp=self._decomp, pencil=self._pencil)
+        if decomp != 'pencil':
+            return mesh
+        if pxpy not in self._pencil_cache:
+            self._pencil_cache[pxpy] = pencil_mesh(
+                *pxpy, devices=list(mesh.devices.reshape(-1)))
+        return self._pencil_cache[pxpy]
 
     def r2c(self, x, norm=None):
-        return dist_rfftn(x, self.mesh, norm=norm)
+        return dist_rfftn(x, self._dispatch_mesh(x.shape, x.dtype),
+                          norm=norm)
 
     def c2r(self, y, norm=None):
-        return dist_irfftn(y, self.Nmesh[2], self.mesh, norm=norm)
+        return dist_irfftn(y, self.Nmesh[2],
+                           self._dispatch_mesh(self.Nmesh, y.dtype),
+                           norm=norm)
+
+    def c2c(self, x, inverse=False, norm=None):
+        return dist_fftn_c2c(x, self._dispatch_mesh(self.Nmesh,
+                                                    x.dtype),
+                             inverse=inverse, norm=norm)

@@ -8,14 +8,20 @@ with no collectives.
 
 Conventions
 -----------
-- The device mesh is 1-D with axis name ``'dev'``. 3-D fields are slab
-  decomposed: a real field of global shape (N0, N1, N2) is sharded
+- The default device mesh is 1-D with axis name ``'dev'``. 3-D fields are
+  slab decomposed: a real field of global shape (N0, N1, N2) is sharded
   ``P('dev', None, None)``; catalogs shard their particle axis the same way.
-- ``CurrentMesh.get()`` returns the ambient mesh (possibly ``None``).
-  Constructors accept ``comm=`` (kept for familiarity with the reference
-  API) holding a ``jax.sharding.Mesh``.
+- A *pencil* mesh is 2-D with axes ``('x', 'y')`` (:func:`pencil_mesh`);
+  fields are then sharded ``P('x', 'y', None)`` and the distributed FFT
+  transposes twice (inner over ``'y'``, outer over ``'x'``) instead of
+  once over the whole fleet. On multi-slice hardware the ``'x'`` axis is
+  laid out across slices (DCN) and ``'y'`` within a slice (ICI).
+- ``CurrentMesh.get()`` returns the ambient mesh (possibly ``None``) and
+  accepts either rank. Constructors accept ``comm=`` (kept for
+  familiarity with the reference API) holding a ``jax.sharding.Mesh``.
 """
 
+import math
 import os
 import threading
 
@@ -24,6 +30,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = 'dev'
+# pencil (2-D) mesh axis names: 'x' is the outer/slow axis (DCN on
+# multi-slice hardware), 'y' the inner/fast axis (ICI within a slice)
+AXIS_X = 'x'
+AXIS_Y = 'y'
 
 
 def init_distributed(coordinator_address=None, num_processes=None,
@@ -105,6 +115,91 @@ def tpu_mesh(n=None):
     return Mesh(np.array(devs), (AXIS,))
 
 
+def default_pencil_factor(n):
+    """The default (Px, Py) factorization of ``n`` devices: the most
+    nearly square factor pair with Px <= Py, so the outer ('x') axis —
+    the one that rides DCN on multi-slice hardware — is the smaller.
+    8 -> (2, 4), 16 -> (4, 4), 7 -> (1, 7)."""
+    px = int(math.isqrt(n))
+    while n % px:
+        px -= 1
+    return px, n // px
+
+
+def _slice_groups(devices):
+    """Group devices by slice (DCN domain). Devices without a
+    slice_index (CPU, single-slice TPU) land in one group."""
+    groups = {}
+    for d in devices:
+        groups.setdefault(getattr(d, 'slice_index', 0), []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def pencil_mesh(px=None, py=None, devices=None):
+    """A 2-D ``Mesh(('x', 'y'))`` over the devices, for the pencil FFT.
+
+    When the job spans multiple slices (DCN present) and the slice count
+    divides Px, the mesh is built with
+    ``mesh_utils.create_hybrid_device_mesh`` so the ``'x'`` axis is laid
+    out across slices — the outer FFT transpose then rides DCN while the
+    inner one stays on ICI (SNIPPETS.md [1] idiom). Otherwise the 1-D
+    device list is plainly reshaped to (Px, Py), which on a single slice
+    (or CPU) makes the flattened (x, y) device order identical to the
+    1-D slab mesh — so slab- and pencil-sharded fields coexist without
+    data movement.
+
+    ``px``/``py`` default to :func:`default_pencil_factor`; passing one
+    of them infers the other. ``py=1`` degenerates to the slab layout.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if px is None and py is None:
+        px, py = default_pencil_factor(n)
+    elif px is None:
+        px = n // int(py)
+    elif py is None:
+        py = n // int(px)
+    px, py = int(px), int(py)
+    if px < 1 or py < 1 or px * py != n:
+        raise ValueError(
+            "pencil factorization %dx%d does not cover %d devices"
+            % (px, py, n))
+    groups = _slice_groups(devices)
+    nslice = len(groups)
+    if nslice > 1 and px % nslice == 0 and \
+            all(len(g) == n // nslice for g in groups):
+        try:
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_hybrid_device_mesh(
+                (px // nslice, py), (nslice, 1), devices=devices)
+            return Mesh(arr, (AXIS_X, AXIS_Y))
+        except Exception:
+            pass  # topology not understood -> plain reshape below
+    return Mesh(np.array(devices).reshape(px, py), (AXIS_X, AXIS_Y))
+
+
+def is_pencil(mesh):
+    """True when ``mesh`` is a 2-D pencil mesh with ('x', 'y') axes."""
+    return mesh is not None and tuple(mesh.axis_names) == (AXIS_X, AXIS_Y)
+
+
+def mesh_shape2d(mesh):
+    """The (Px, Py) shape of a pencil mesh, or None for slab/None."""
+    if not is_pencil(mesh):
+        return None
+    return (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
+
+
+def leading_axes(mesh):
+    """The mesh axis name(s) a field's leading dimension shards over:
+    ``'dev'`` on the slab mesh, ``('x', 'y')`` flattened on a pencil."""
+    if is_pencil(mesh):
+        return (AXIS_X, AXIS_Y)
+    return AXIS
+
+
 class CurrentMesh(object):
     """A stack of ambient device meshes, mirroring the reference's
     ``CurrentMPIComm`` stack semantics (nbodykit/__init__.py:107-190).
@@ -175,10 +270,13 @@ class use_mesh(object):
 
 
 def mesh_size(mesh):
-    """Number of devices along the shard axis (1 when mesh is None)."""
+    """Total number of devices in the mesh (1 when mesh is None).
+
+    Accepts either rank: the 1-D slab mesh or a 2-D pencil mesh.
+    """
     if mesh is None:
         return 1
-    return mesh.shape[AXIS]
+    return int(math.prod(mesh.shape.values()))
 
 
 def sharding(mesh, *spec):
@@ -198,10 +296,10 @@ def shard_leading(mesh, arr):
     """
     if mesh is None:
         return arr
-    n = mesh.shape[AXIS]
+    n = mesh_size(mesh)
     if arr.shape[0] % n:
         return arr
-    spec = (AXIS,) + (None,) * (arr.ndim - 1)
+    spec = (leading_axes(mesh),) + (None,) * (arr.ndim - 1)
     from ..diagnostics import counter, span_if
     eager = not isinstance(arr, jax.core.Tracer)
     nbytes = int(getattr(arr, 'nbytes', 0) or 0)

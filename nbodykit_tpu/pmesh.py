@@ -664,7 +664,8 @@ class ParticleMesh(object):
 def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
                 paint_method='scatter', paint_chunk=None,
                 paint_streams=None, hbm_bytes=16e9, exchange='counted',
-                exchange_imbalance=1.5):
+                exchange_imbalance=1.5, fft_decomp='slab',
+                fft_pencil=None):
     """Estimated peak per-device HBM for the FFTPower pipeline
     (paint -> rFFT -> |delta_k|^2 -> chunked binning) — the arithmetic
     behind chunk-size choices and the BASELINE.md scale claims
@@ -683,6 +684,16 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     pass 1 of the two-pass exchange); 'ceil' is the traced fallback
     bound ceil(N/P) per pair (npart payload slots per device — the
     safe-but-fat bound that cannot sit next to a 2048^3 mesh).
+
+    ``fft_decomp='pencil'`` (multi-device) swaps the slab FFT
+    workspace for the pencil path's staging buffers: exactly
+    :data:`~nbodykit_tpu.parallel.dfft.PENCIL_BUFFERS` (= 2) padded
+    complex pencil units per device — stage 1's output plus stage 2's
+    output, stage 2 donating stage 1's intermediate — where the pad
+    grows the Hermitian z length Nc = N2//2+1 to the next multiple of
+    Py (``fft_pencil`` = (Px, Py); near-square default).  The report
+    gains ``fft_pencil_buffers`` / ``fft_pencil`` keys so the smoke
+    gate can assert the documented count at the 1024^3 config.
     """
     N = _triplet(Nmesh, 'i8')
     ndev = max(int(ndevices), 1)
@@ -693,6 +704,24 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     real = item * ncells / ndev
     cplx = 2 * item * (N[0] * N[1] * (N[2] // 2 + 1)) / ndev
     fft_ws = 2 * cplx
+    pencil_extra = {}
+    if fft_decomp == 'pencil' and ndev > 1:
+        from .parallel.dfft import PENCIL_BUFFERS
+        if fft_pencil is None:
+            from .parallel.runtime import default_pencil_factor
+            fft_pencil = default_pencil_factor(ndev)
+        px, py = int(fft_pencil[0]), int(fft_pencil[1])
+        nc = int(N[2]) // 2 + 1
+        ncp = nc + (-nc % py)
+        # one padded complex pencil unit per device; the eager path
+        # holds PENCIL_BUFFERS of them at peak (stage-1 out + stage-2
+        # out, stage 2 donating) — same 2x count as the slab model,
+        # scaled by the z pad that makes Nc divisible by Py
+        stage = 2 * item * (N[0] * N[1] * ncp) / ndev
+        fft_ws = PENCIL_BUFFERS * stage
+        pencil_extra = {'fft_pencil': '%dx%d' % (px, py),
+                        'fft_pencil_buffers': PENCIL_BUFFERS,
+                        'fft_pencil_pad': float(ncp) / float(nc)}
     pos_b = 3 * item * npart / ndev
     if paint_chunk is None:
         chunk = _global_options['paint_chunk_size']
@@ -773,6 +802,7 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
         'exchange_buffers': exch,
         'power3d': p3,
     }
+    phases.update(pencil_extra)
     # paint phase: field + positions + temporaries + exchange;
     # fft phase: real + complex + workspace (positions still resident
     # unless donated); binning adds only O(chunk) slabs

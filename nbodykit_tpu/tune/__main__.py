@@ -16,8 +16,9 @@
         summary; exit 1 on a malformed file (the smoke gate).
 
     Options: --ops paint,fft,exchange · --paint-shapes 64x1e4,128x1e5
-    · --fft-nmesh 64,128 · --reps N · --cache PATH · --devices N
-    (CPU: force N virtual devices and tune on that mesh).
+    · --fft-nmesh 64,128 · --pencil PXxPY (fft decomp factorization)
+    · --reps N · --cache PATH · --devices N (CPU: force N virtual
+    devices and tune on that mesh).
 
 The committed repo-root TUNE_CACHE.json is produced by exactly this
 command on the 8-device CPU mesh; the on-chip run (same command over
@@ -57,9 +58,28 @@ def _contexts(args, spaces, nproc):
                            'dtype': 'f4', 'resampler': 'cic',
                            'seed': 7}))
     if 'fft' in ops:
+        # multi-device ffts also race fft_decomp; the ctx records the
+        # (Px, Py) factorization the pencil candidate runs with
+        # (--pencil override, else the near-square default), and the
+        # entry is keyed under it (cache.shape_class)
+        mesh_shape = None
+        if nproc > 1:
+            if args.pencil:
+                px, _, py = args.pencil.lower().partition('x')
+                mesh_shape = (int(px), int(py))
+                if mesh_shape[0] * mesh_shape[1] != nproc:
+                    raise SystemExit(
+                        '--pencil %s does not cover %d devices'
+                        % (args.pencil, nproc))
+            else:
+                from ..parallel.runtime import default_pencil_factor
+                mesh_shape = default_pencil_factor(nproc)
         for nmesh in [int(x) for x in args.fft_nmesh.split(',') if x]:
-            pairs.append((spaces['fft'],
-                          {'nmesh': nmesh, 'dtype': 'f4', 'seed': 7}))
+            ctx = {'nmesh': nmesh, 'dtype': 'f4', 'seed': 7,
+                   'nproc': nproc}
+            if mesh_shape is not None:
+                ctx['mesh_shape'] = list(mesh_shape)
+            pairs.append((spaces['fft'], ctx))
     if 'exchange' in ops and nproc > 1:
         for _, npart in _parse_paint_shapes(args.paint_shapes)[-1:]:
             pairs.append((spaces['exchange'],
@@ -78,6 +98,10 @@ def main(argv=None):
                          "separated (default: 64x1e4,128x1e5)")
     ap.add_argument('--fft-nmesh', default='64,128',
                     help='FFT trial mesh sizes (default: 64,128)')
+    ap.add_argument('--pencil', default=None,
+                    help="fft decomp trials: (Px, Py) factorization "
+                         "as 'PXxPY' (default: the near-square "
+                         "factorization of the device count)")
     ap.add_argument('--reps', type=int, default=2,
                     help='timed reps per candidate (default 2)')
     ap.add_argument('--cache', default=None,

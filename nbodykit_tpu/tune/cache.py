@@ -36,15 +36,18 @@ import numpy as np
 # committed cache is a validation error, not silently applied)
 TUNABLE_OPTIONS = ('paint_method', 'paint_order', 'paint_deposit',
                    'paint_chunk_size', 'paint_bucket_slack',
-                   'paint_streams', 'fft_chunk_bytes',
-                   'exchange_slack')
+                   'paint_streams', 'fft_chunk_bytes', 'fft_decomp',
+                   'fft_pencil', 'exchange_slack')
 
 STALE_DAYS = 30.0
 
 _ENTRY_REQUIRED = ('platform', 'device_kind', 'device_count', 'op',
                    'shape_class', 'dtype', 'measured_at')
 
-_CLASS_RE = re.compile(r'^mesh(\d+)(?:-part1e(\d+))?$|^part1e(\d+)$')
+_CLASS_RE = re.compile(
+    r'^mesh(\d+)(?:-part1e(\d+))?(?:-g\d+x\d+)?$'
+    r'|^part1e(\d+)(?:-g\d+x\d+)?$')
+_FACTOR_RE = re.compile(r'-g(\d+)x(\d+)$')
 
 
 def utcnow():
@@ -54,10 +57,18 @@ def utcnow():
 # ---------------------------------------------------------------------------
 # shape classes
 
-def shape_class(nmesh=None, npart=None):
+def shape_class(nmesh=None, npart=None, mesh_shape=None):
     """The logarithmic shape bucket for (nmesh, npart):
     ``mesh512-part1e7`` / ``mesh512`` / ``part1e7``.  Nmesh buckets to
-    the nearest power of two, Npart to the nearest decade."""
+    the nearest power of two, Npart to the nearest decade.
+
+    ``mesh_shape`` is the (Px, Py) device-mesh factorization when the
+    op's ranking depends on it (the fft decomp knob): it appends
+    ``-g4x2``-style suffix, making classes measured under different
+    factorizations mutually incomparable (:func:`class_distance`) — a
+    pencil winner measured on a 4x2 mesh must never be replayed onto
+    8x1, where the two transposes have entirely different shapes.
+    """
     parts = []
     if nmesh:
         parts.append('mesh%d' % (1 << max(0, int(round(
@@ -67,6 +78,9 @@ def shape_class(nmesh=None, npart=None):
             math.log10(float(npart))))))
     if not parts:
         raise ValueError('shape_class needs nmesh and/or npart')
+    if mesh_shape is not None:
+        px, py = mesh_shape
+        parts.append('g%dx%d' % (int(px), int(py)))
     return '-'.join(parts)
 
 
@@ -83,12 +97,24 @@ def class_coords(sclass):
     return (lm, lp)
 
 
+def class_factorization(sclass):
+    """The (Px, Py) device-mesh factorization suffix of a shape class
+    (``mesh256-g4x2`` -> (4, 2)), or None when absent."""
+    m = _FACTOR_RE.search(str(sclass))
+    if not m:
+        return None
+    return (int(m.group(1)), int(m.group(2)))
+
+
 def class_distance(a, b):
     """Log-space distance between two shape classes; None when either
     does not parse or they describe different axes (a mesh-only class
-    is not comparable to a part-only one)."""
+    is not comparable to a part-only one, and classes keyed under
+    different device-mesh factorizations are mutually incomparable)."""
     ca, cb = class_coords(a), class_coords(b)
     if ca is None or cb is None:
+        return None
+    if class_factorization(a) != class_factorization(b):
         return None
     d = 0.0
     for xa, xb in zip(ca, cb):

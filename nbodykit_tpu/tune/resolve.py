@@ -31,6 +31,8 @@ FALLBACKS = {
     'paint_chunk_size': 1024 * 1024 * 16,
     'paint_streams': 4,            # replica meshes of the streams kernel
     'fft_chunk_bytes': 2 ** 31,
+    'fft_decomp': 'slab',          # cold cache: the proven decomposition
+    'fft_pencil': None,            # near-square default (runtime.py)
     'exchange_slack': 1.05,
 }
 
@@ -120,19 +122,56 @@ def resolve_paint_deposit(nmesh=None, npart=None, dtype='f4', nproc=1):
     return FALLBACKS['paint_deposit'] if dep == 'auto' else dep
 
 
-def resolve_fft_chunk_bytes(shape=None, dtype='f4', nproc=1):
+def resolve_fft_chunk_bytes(shape=None, dtype='f4', nproc=1,
+                            mesh_shape=None):
     """Concrete ``fft_chunk_bytes`` when the option is ``'auto'``:
     the cache winner for the nearest measured mesh class, else the
-    pre-tuner default (2**31)."""
+    pre-tuner default (2**31).  ``mesh_shape`` is the (Px, Py) pencil
+    factorization when one is in play — it narrows the lookup to
+    entries measured under the same factorization (the shape class
+    carries it; see cache.py)."""
     v = _current('fft_chunk_bytes')
     if not isinstance(v, bool) and isinstance(v, (int, float)):
         return int(v)
     nmesh = int(max(shape)) if shape else None
     winner, _ = _consult('fft',
-                         shape_class(nmesh=nmesh) if nmesh
+                         shape_class(nmesh=nmesh,
+                                     mesh_shape=mesh_shape) if nmesh
                          else 'mesh1', dtype, nproc)
     return int(winner.get('fft_chunk_bytes',
                           FALLBACKS['fft_chunk_bytes']))
+
+
+def resolve_fft_decomp(shape=None, dtype='f4', nproc=1,
+                       mesh_shape=None):
+    """The measured slab-vs-pencil winner for
+    ``set_options(fft_decomp='auto')``: ``('slab'|'pencil',
+    (Px, Py) or None)``.
+
+    Consults the cache keyed by (device_count, shape_class) where the
+    shape class carries the (Px, Py) factorization the transform WOULD
+    run with — so a pencil winner measured on 4x2 can only answer 4x2
+    questions (ISSUE 9 satellite: the key must not ignore the device
+    mesh shape).  Cold cache → ``('slab', None)`` at zero trial cost.
+    """
+    nmesh = int(max(shape)) if shape else None
+    winner, _ = _consult('fft',
+                         shape_class(nmesh=nmesh,
+                                     mesh_shape=mesh_shape) if nmesh
+                         else 'mesh1', dtype, nproc)
+    decomp = winner.get('fft_decomp', FALLBACKS['fft_decomp'])
+    if decomp not in ('slab', 'pencil'):
+        decomp = FALLBACKS['fft_decomp']
+    pencil = winner.get('fft_pencil') or None
+    if pencil is not None:
+        try:
+            px, _, py = str(pencil).lower().partition('x')
+            pencil = (int(px), int(py))
+            if pencil[0] * pencil[1] != int(nproc):
+                pencil = None
+        except ValueError:
+            pencil = None
+    return decomp, pencil
 
 
 def resolve_exchange_slack(npart=None, nproc=1):
@@ -170,6 +209,9 @@ def tuned_snapshot(nmesh=None, npart=None, dtype='f4', nproc=1):
     fft_v = _current('fft_chunk_bytes')
     fft_auto = not isinstance(fft_v, (int, float)) \
         or isinstance(fft_v, bool)
+    from ..parallel.dfft import resolve_decomp
+    decomp, pxpy = resolve_decomp(
+        nproc, shape=(nmesh,) * 3 if nmesh else None, dtype=dtype)
     return {
         'paint_method': paint['paint_method'],
         'paint_order': paint['paint_order'],
@@ -179,7 +221,15 @@ def tuned_snapshot(nmesh=None, npart=None, dtype='f4', nproc=1):
         'paint_source': paint['source'],
         'fft_chunk_bytes': resolve_fft_chunk_bytes(
             shape=(nmesh,) * 3 if nmesh else None, dtype=dtype,
-            nproc=nproc),
+            nproc=nproc,
+            mesh_shape=pxpy if decomp == 'pencil' else None),
         'fft_source': 'auto' if fft_auto else 'explicit',
+        # the resolved decomposition and device-mesh shape this
+        # measurement actually ran with (BENCH_r07+ attributability)
+        'fft_decomp': decomp,
+        'fft_pencil': ('%dx%d' % pxpy
+                       if (pxpy and decomp == 'pencil') else None),
+        'fft_decomp_source': (
+            'auto' if _current('fft_decomp') == 'auto' else 'explicit'),
         'cache': TuneCache().path,
     }

@@ -21,7 +21,12 @@ scatter on real hardware at every measured scale):
   lowers, :func:`~nbodykit_tpu.ops.paint_pallas.
   pallas_deposit_lowers`);
 - **fft** — the single-device ``fft_chunk_bytes`` dispatch target
-  (one-shot in-jit vs slab-chunked vs eager lowmem);
+  (one-shot in-jit vs slab-chunked vs eager lowmem), and on
+  multi-device contexts the ``fft_decomp`` knob (slab's one P-way
+  all_to_all vs the pencil path's two smaller transposes over a 2-D
+  mesh); fft entries are keyed by the (Px, Py) factorization the
+  pencil candidate runs with, so a winner measured on 4x2 never
+  answers an 8x1 question;
 - **exchange** — the counted-capacity slack of the particle
   ``all_to_all`` (multi-device contexts only).
 """
@@ -67,8 +72,13 @@ class SearchSpace(object):
         return list(self._candidates(ctx))
 
     def shape_class(self, ctx):
+        # a ctx carrying 'mesh_shape' (the (Px, Py) factorization its
+        # trials run with — the fft space on a multi-device mesh) keys
+        # its entry under that factorization: decomp winners must not
+        # travel across device-mesh shapes (cache.class_distance)
         return shape_class(nmesh=ctx.get('nmesh'),
-                           npart=ctx.get('npart'))
+                           npart=ctx.get('npart'),
+                           mesh_shape=ctx.get('mesh_shape'))
 
 
 def _sync(out):
@@ -197,9 +207,24 @@ def paint_space():
 def _fft_candidates(ctx):
     # the real dispatch ladder: one-shot in-jit, then ever-smaller
     # slab-chunked / lowmem passes (parallel/dfft.py)
-    return [Candidate('chunk2g', {'fft_chunk_bytes': 2 ** 31}),
-            Candidate('chunk256m', {'fft_chunk_bytes': 2 ** 28}),
-            Candidate('chunk64m', {'fft_chunk_bytes': 2 ** 26})]
+    cands = [Candidate('chunk2g', {'fft_chunk_bytes': 2 ** 31}),
+             Candidate('chunk256m', {'fft_chunk_bytes': 2 ** 28}),
+             Candidate('chunk64m', {'fft_chunk_bytes': 2 ** 26})]
+    for c in cands:
+        c.options.setdefault('fft_decomp', 'slab')
+    # multi-device contexts also race the decomposition itself: the
+    # pencil path (two smaller transposes over a 2-D mesh) vs slab's
+    # one P-way all_to_all. The factorization comes from the ctx (the
+    # CLI stamps the one the transform would run with) so the entry's
+    # shape class — and therefore the winner's reach — carries it.
+    nproc = int(ctx.get('nproc', 1))
+    if nproc > 1 and ctx.get('mesh_shape'):
+        px, py = ctx['mesh_shape']
+        cands.append(Candidate(
+            'pencil%dx%d' % (px, py),
+            {'fft_decomp': 'pencil', 'fft_pencil': '%dx%d' % (px, py),
+             'fft_chunk_bytes': 2 ** 31}))
+    return cands
 
 
 def _fft_runner(ctx):
@@ -222,7 +247,8 @@ def _fft_runner(ctx):
 
 
 def fft_space():
-    return SearchSpace('fft', ('fft_chunk_bytes',),
+    return SearchSpace('fft',
+                       ('fft_chunk_bytes', 'fft_decomp', 'fft_pencil'),
                        _fft_candidates, _fft_runner)
 
 

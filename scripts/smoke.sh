@@ -71,13 +71,77 @@ for fn in ("run_once", "rfftn_single_lowmem"):
         "staged/lowmem chain exceeded the budget: " + line)
 '
 
+# pencil branch of the memory model (docs/PERF.md): the documented
+# 2-buffer eager contract (stage-2 donates stage-1) must keep pricing
+# the north-star config — a drift between PENCIL_BUFFERS and the plan
+# fails here, not on chip
+echo "== memory plan: pencil buffer contract (1024^3, 8 dev) =="
+python -c '
+from nbodykit_tpu.parallel.dfft import PENCIL_BUFFERS
+from nbodykit_tpu.pmesh import memory_plan
+plan = memory_plan(1024, int(1e8), ndevices=8, fft_decomp="pencil")
+assert plan["fft_pencil_buffers"] == PENCIL_BUFFERS == 2, plan
+assert plan["fft_pencil"] == "2x4", plan
+assert plan["fft_pencil_pad"] >= 1.0, plan
+slab = memory_plan(1024, int(1e8), ndevices=8)
+assert plan["fft_workspace"] >= slab["fft_workspace"], (plan, slab)
+print("pencil plan OK: %s buffers=%d pad=%.4f fft_ws=%.2f GB" % (
+    plan["fft_pencil"], plan["fft_pencil_buffers"],
+    plan["fft_pencil_pad"], plan["fft_workspace"] / 2**30))
+'
+
+# pencil dist_rfftn end-to-end gate: a 4x2 pencil transform at
+# mesh128 must match the slab path and round-trip through c2r at
+# double precision — the two group transposes run for real on the
+# 8-device CPU mesh
+echo "== pencil FFT roundtrip gate (mesh128, 4x2) =="
+python -c '
+from nbodykit_tpu._jax_compat import set_cpu_devices
+set_cpu_devices(8)
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import jax.numpy as jnp
+from nbodykit_tpu.parallel import dfft
+from nbodykit_tpu.parallel.runtime import cpu_mesh, pencil_mesh
+x = jnp.asarray(np.random.RandomState(7).standard_normal(
+    (128, 128, 128)), jnp.float64)
+pm = pencil_mesh(4, 2)
+y = dfft.dist_rfftn(x, pm)
+slab = dfft.dist_rfftn(x, cpu_mesh())
+np.testing.assert_allclose(np.asarray(y), np.asarray(slab),
+                           atol=1e-10)
+back = dfft.dist_irfftn(y, 128, pm)
+err = float(jnp.max(jnp.abs(back - x)))
+assert err < 1e-10, err
+print("pencil roundtrip OK: mesh128 4x2, max|irfftn(rfftn(x))-x| "
+      "= %.3e" % err)
+'
+
 # autotuner gates (docs/TUNE.md): the bounded --dry-run proves the
-# deterministic trial plan still builds without touching a device;
-# --validate fails the smoke run on a malformed committed
-# TUNE_CACHE.json (a broken database must never silently steer
-# dispatch)
+# deterministic trial plan still builds without touching a device —
+# and that every multi-device fft trial races BOTH decompositions
+# (chunk-laddered slab + the pencil candidate) under a
+# factorization-suffixed shape class; --validate fails the smoke run
+# on a malformed committed TUNE_CACHE.json (a broken database must
+# never silently steer dispatch)
 echo "== tune: dry-run plan + cache validation gate =="
-python -m nbodykit_tpu.tune --dry-run --devices 8 > /dev/null
+python -m nbodykit_tpu.tune --dry-run --devices 8 | python -c '
+import json, sys
+plan = json.load(sys.stdin)["plan"]
+ffts = [p for p in plan if p["op"] == "fft"]
+assert ffts, "no fft trials in the plan"
+for p in ffts:
+    cands = p["candidates"]
+    assert any(c.startswith("chunk") for c in cands), (
+        "slab chunk ladder missing: %r" % cands)
+    assert any(c.startswith("pencil") for c in cands), (
+        "pencil decomposition candidate missing: %r" % cands)
+    assert "-g" in p["shape_class"], (
+        "factorization suffix missing: %r" % p["shape_class"])
+print("tune plan OK: fft candidates " + " ".join(ffts[0]["candidates"])
+      + " @ " + " ".join(p["shape_class"] for p in ffts))
+'
 python -m nbodykit_tpu.tune --validate
 
 # paint candidate gate (docs/PERF.md): every registered paint
@@ -140,6 +204,7 @@ python -m pytest \
     tests/test_lint_dataflow.py \
     tests/test_jax_compat.py \
     tests/test_pmesh.py \
+    tests/test_pencil_fft.py \
     tests/test_paint_kernels.py \
     tests/test_fftpower.py \
     tests/test_counted_exchange.py \
