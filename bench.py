@@ -993,6 +993,62 @@ def run_fft_decomp(Nmesh=256, reps=3):
     return _stamp(rec)
 
 
+def run_serve_trace(n=1000, per_task=1, max_batch=8, seed=0):
+    """The multi-tenant serving round: replay a deterministic
+    ``n``-request synthetic trace (nbodykit_tpu.serve.synth — Zipf
+    shape popularity, mixed priorities/deadlines, a slice of hopeless
+    admission-rejects) through a live :class:`AnalysisServer` on the
+    process-visible devices, and report requests/sec + real p50/p99.
+
+    Fault injection rides ``NBKIT_FAULTS`` (the regress round injects
+    ``serve.request.*`` faults so the record proves the fleet survives
+    a mid-request tunnel death: exactly the faulted requests retry /
+    degrade / resume, ``lost`` stays 0).  ``value`` is p99 seconds —
+    lower is better, which is what regress.py trends."""
+    jax = _setup_jax()
+    import nbodykit_tpu
+    from nbodykit_tpu.resilience.faults import fault_counts, \
+        reset_faults
+    from nbodykit_tpu.serve import (AnalysisServer, BatchPolicy,
+                                    generate_trace, replay)
+    from nbodykit_tpu.tune.resolve import tuned_snapshot
+
+    ndev = len(jax.devices())
+    rec = {"metric": "servetrace_n%d" % n, "unit": "s",
+           "platform": jax.devices()[0].platform, "requests": n,
+           "ndevices": ndev, "per_task": per_task,
+           "max_batch": max_batch, "seed": seed,
+           "faults_spec": os.environ.get('NBKIT_FAULTS', '')}
+    reset_faults()
+    trace = generate_trace(n, seed=seed, deadline_s=600.0)
+    t0 = time.time()
+    with AnalysisServer(per_task=per_task, max_queue=max(n, 16),
+                        batch=BatchPolicy(max_batch=max_batch,
+                                          max_delay_s=0.05)) as srv:
+        replay(srv, trace, seed=seed)
+        summary = srv.summary()
+    rec['wall_s'] = round(time.time() - t0, 3)
+    for key in ('submitted', 'completed', 'rejected', 'evicted',
+                'failed', 'lost', 'retried', 'fault_degraded',
+                'resumed', 'admit_degraded', 'workers', 'programs'):
+        rec[key] = summary[key]
+    rec['degraded'] = summary['fault_degraded']
+    rec['rps'] = round(summary['rps'], 3)
+    for key in ('p50_s', 'p99_s', 'mean_s'):
+        rec[key] = round(summary[key], 5) \
+            if summary[key] is not None else None
+    rec['table'] = summary['by_class']
+    rec['faults_injected'] = {k: v for k, v in fault_counts().items()
+                             if k.startswith('serve.')}
+    rec['tuned'] = tuned_snapshot(nmesh=64, npart=50000, dtype='f4',
+                                  nproc=per_task)
+    if summary['lost']:
+        rec['error'] = ('%d request(s) lost without a structured '
+                        'verdict' % summary['lost'])
+    rec['value'] = rec['p99_s'] if rec['p99_s'] is not None else -1.0
+    return _stamp(rec)
+
+
 def _paint_method_options(method, Nmesh, Npart):
     """``set_options`` kwargs selecting one paint configuration by
     name.
@@ -1600,6 +1656,13 @@ if __name__ == '__main__':
         print(json.dumps(run_paint_all(
             int(argv[1]), int(argv[2]),
             reps=int(argv[3]) if argv[3:] else 3)))
+        sys.exit(0)
+    if argv[0] == '--serve-trace':
+        print(json.dumps(run_serve_trace(
+            int(argv[1]) if argv[1:] else 1000,
+            per_task=int(argv[2]) if argv[2:] else 1,
+            max_batch=int(argv[3]) if argv[3:] else 8,
+            seed=int(argv[4]) if argv[4:] else 0)))
         sys.exit(0)
     print("unknown args: %r" % (argv,), file=sys.stderr)
     sys.exit(2)

@@ -235,6 +235,38 @@ class set_options(object):
         _global_options.update(self.old)
 
 
+@contextmanager
+def option_scope(**overrides):
+    """Request-scoped option override that CANNOT leak.
+
+    ``set_options`` used as a context manager restores the values it
+    saved — but a bare ``set_options(...)`` call inside the block (or
+    inside library code the block runs) survives it.  On the main
+    thread that is a deliberate feature; on a long-lived worker thread
+    that is a cross-tenant leak: ``_Options`` gives every non-main
+    thread a persistent thread-local dict, so whatever request N
+    leaves behind becomes request N+1's ambient configuration when the
+    pool reuses the thread.
+
+    This context snapshots the calling thread's FULL option dict on
+    entry and restores it wholesale on exit, so nothing set inside the
+    scope — by ``overrides``, by nested ``set_options``, by a
+    degradation-ladder rung — outlives it.  The serving layer
+    (:mod:`nbodykit_tpu.serve`) wraps every request in one.
+    """
+    for key in overrides:
+        if key not in _global_options:
+            raise KeyError('invalid option: %r (valid: %s)'
+                           % (key, sorted(_global_options)))
+    saved = _global_options.copy()
+    _global_options.update(overrides)
+    try:
+        yield
+    finally:
+        _global_options.clear()
+        _global_options.update(saved)
+
+
 # ---------------------------------------------------------------------------
 # logging (reference: nbodykit/__init__.py:258-300)
 # ---------------------------------------------------------------------------

@@ -89,6 +89,14 @@ class TaskManager(object):
             or groups[:1]
         return [Mesh(np.array(g), (AXIS,)) for g in groups]
 
+    def sub_meshes(self):
+        """The task-group device sub-meshes this manager farms onto
+        (single-process form).  Public so long-lived layers on top —
+        the serving loop of :mod:`nbodykit_tpu.serve` pins one worker
+        thread per sub-mesh — partition devices exactly the way
+        :meth:`map` does."""
+        return self._sub_meshes()
+
     # -- multi-host farming -----------------------------------------------
 
     def _process_groups(self):
@@ -268,8 +276,30 @@ class TaskManager(object):
             finally:
                 pool.put(mesh)
 
+        # submit + collect explicitly (not ex.map): a raising task
+        # must surface its ORIGINAL exception and traceback, tagged
+        # with the task index, while already-running tasks on the
+        # other sub-meshes complete and still-queued ones are
+        # cancelled — never a deadlock, never a swallowed error.
         with ThreadPoolExecutor(max_workers=len(meshes)) as ex:
-            return list(ex.map(run, tasks))
+            futures = [ex.submit(run, t) for t in tasks]
+            results, first_err = [], None
+            for i, fut in enumerate(futures):
+                try:
+                    results.append(fut.result())
+                except BaseException as e:
+                    if first_err is None:
+                        first_err = (i, e)
+                        for later in futures[i + 1:]:
+                            later.cancel()
+                    results.append(None)
+            if first_err is not None:
+                i, e = first_err
+                self.logger.error("task %d raised %s: %s",
+                                  i, type(e).__name__, e)
+                e.task_index = i
+                raise e
+            return results
 
     def is_root(self):
         return True

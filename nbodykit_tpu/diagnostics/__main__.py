@@ -522,6 +522,51 @@ def run_doctor(trace=None, root='.', self_check_only=False,
             lines.append('resilience   OK: %s; no pending '
                          'checkpoints%s' % (activity, extra))
 
+    if root is not None:
+        # serving posture: the latest committed servetrace round.  The
+        # ONE hard failure is a lost request — a submission that ended
+        # with no structured verdict; everything else (rejections,
+        # evictions, degradations) is the server doing its job and is
+        # reported, not punished.
+        from .regress import serve_summary
+        srv = serve_summary(root)
+        if srv is None:
+            lines.append('serve        SKIP: no servetrace record in '
+                         'any committed bench round')
+        elif 'error' in srv:
+            warn.append('serve')
+            lines.append('serve        WARN: serve summary unavailable '
+                         '(%s)' % srv['error'])
+        else:
+            # fault_counts() tallies point HITS, not rules fired — name
+            # the injected points rather than pretend a fired count
+            fpoints = sorted((srv.get('faults_injected') or {}))
+            desc = ('%s req @ %s rps, p99 %ss; rejected=%s evicted=%s '
+                    'failed=%s degraded=%s resumed=%s'
+                    % (srv.get('requests', '?'), srv.get('rps', '?'),
+                       srv.get('p99_s', '?'), srv.get('rejected', '?'),
+                       srv.get('evicted', '?'), srv.get('failed', '?'),
+                       srv.get('degraded', '?'),
+                       srv.get('resumed', '?')))
+            if fpoints:
+                desc += ('; faults injected at %s — survived'
+                         % ', '.join(fpoints))
+            lost = srv.get('lost')
+            if lost:
+                fail.append('serve')
+                lines.append('serve        FAIL: %s request(s) lost '
+                             'WITHOUT a structured verdict (%s) — '
+                             'every submission must end as a result'
+                             % (lost, desc))
+            elif srv.get('failed'):
+                warn.append('serve')
+                lines.append('serve        WARN: %s — failed requests '
+                             'got structured verdicts but the errors '
+                             'deserve a look (%s)'
+                             % (srv.get('failed'), desc))
+            else:
+                lines.append('serve        OK: %s' % desc)
+
     verdict = 'FAIL (%s)' % ', '.join(fail) if fail else \
         ('WARN (%s)' % ', '.join(warn) if warn else 'OK')
     out.write('== nbodykit-tpu doctor ==\n')

@@ -55,6 +55,8 @@ _PHASE_PREFIXES = (
     # supervisor dead time is attributed, not hidden in 'other'
     ('resilience.', 'resilience'),
     ('ckpt.', 'resilience'),
+    # per-request serving spans (nbodykit_tpu.serve)
+    ('serve.', 'serve'),
 )
 
 

@@ -315,6 +315,54 @@ def resilience_summary(root, now=None):
     return out
 
 
+def serve_summary(root):
+    """Serving posture for the round record: the latest committed
+    ``servetrace_*`` bench record (nbodykit_tpu.serve via ``bench.py
+    --serve-trace``) reduced to the numbers the doctor judges —
+    throughput, tail latency, the admission/eviction/fault ledger and
+    above all ``lost``, which must be zero.  ``None`` when no round
+    carries a serve record; never raises.
+
+    Reads the round files directly: :func:`load_rounds` flattens the
+    ``parsed`` record to the headline keys, and the serve ledger
+    (lost/retried/degraded/...) is not among them."""
+    latest = None
+    try:
+        for pattern in ROUND_GLOBS:
+            for path in sorted(glob.glob(os.path.join(root, pattern)),
+                               key=_round_key):
+                try:
+                    with open(path) as f:
+                        rec = json.load(f).get('parsed') or {}
+                except (OSError, ValueError):
+                    continue
+                metric = str(rec.get('metric', ''))
+                if not metric.startswith('servetrace'):
+                    continue
+                latest = {
+                    'round': os.path.basename(path),
+                'metric': metric,
+                'requests': rec.get('requests'),
+                'rps': rec.get('rps'),
+                'p50_s': rec.get('p50_s'),
+                'p99_s': rec.get('p99_s'),
+                'completed': rec.get('completed'),
+                'rejected': rec.get('rejected'),
+                'evicted': rec.get('evicted'),
+                'failed': rec.get('failed'),
+                'lost': rec.get('lost'),
+                'retried': rec.get('retried'),
+                'degraded': rec.get('degraded',
+                                    rec.get('fault_degraded')),
+                'resumed': rec.get('resumed'),
+                'admit_degraded': rec.get('admit_degraded'),
+                'faults_injected': rec.get('faults_injected'),
+            }
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+    return latest
+
+
 def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
                   now=None, write=True):
     """Assemble + (atomically) write ``BENCH_HISTORY.json``; returns
@@ -332,6 +380,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'lint': lint_summary(root),
         'tune': tune_summary(root, now=now),
         'resilience': resilience_summary(root, now=now),
+        'serve': serve_summary(root),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
                            if e.get('verdict') == v)
@@ -390,6 +439,23 @@ def render_regress(history):
                            res.get('oldest_checkpoint_hours', '?')))
         if bits:
             w('  resilience: %s' % '; '.join(bits))
+    serve = history.get('serve')
+    if serve is not None:
+        if 'error' in serve:
+            w('  serve: unavailable (%s)' % serve['error'])
+        else:
+            # fault_counts() tallies point HITS, not rules fired — the
+            # honest render is which points were under injection
+            fpoints = sorted((serve.get('faults_injected') or {}))
+            w('  serve: %s req @ %s rps, p99 %ss — %s rejected, '
+              '%s evicted, %s degraded, %s resumed, %s lost%s'
+              % (serve.get('requests', '?'), serve.get('rps', '?'),
+                 serve.get('p99_s', '?'), serve.get('rejected', '?'),
+                 serve.get('evicted', '?'),
+                 serve.get('degraded', '?'), serve.get('resumed', '?'),
+                 serve.get('lost', '?'),
+                 ', faults injected at %s and survived'
+                 % ', '.join(fpoints) if fpoints else ''))
     tune = history.get('tune')
     if tune is not None:
         if 'error' in tune:

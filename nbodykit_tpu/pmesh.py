@@ -810,5 +810,10 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
                real + cplx + fft_ws + pos_b,
                cplx + p3 + pos_b)
     phases['peak_bytes'] = peak
+    # the budget the admission controller (nbodykit_tpu.serve) prices
+    # against: the raw HBM less the 15% allocator margin.  Exposed so
+    # structured rejections can quote the numbers they were judged by.
+    phases['budget_bytes'] = 0.85 * hbm_bytes
+    phases['headroom_bytes'] = 0.85 * hbm_bytes - peak
     phases['fits'] = bool(peak <= 0.85 * hbm_bytes)
     return phases

@@ -40,4 +40,4 @@ from .faults import (ACTIONS, InjectedFault, error_class,  # noqa: F401
                      reset_faults)
 from .supervise import (DEADLINE, FATAL, OOM, TRANSIENT,  # noqa: F401
                         DegradationLadder, RetryPolicy, Supervisor,
-                        classify_error, default_ladder)
+                        classify_error, default_ladder, scoped_ladder)

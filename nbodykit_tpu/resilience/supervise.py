@@ -151,6 +151,47 @@ def default_ladder():
     ])
 
 
+def _halve_scoped(opts, option, floor):
+    """A ladder rung halving an option INSIDE a caller-owned mapping
+    (not below ``floor``).  The first step seeds from the mapping's
+    current value when present, else from the tune-cache-resolved
+    effective value — same pinning discipline as :func:`_halve_option`
+    but with zero writes to the process-wide options."""
+    def apply():
+        from ..tune.resolve import effective_int_option
+        cur = opts.get(option)
+        if cur is None or isinstance(cur, bool) \
+                or not isinstance(cur, (int, float)):
+            cur = effective_int_option(option)
+        cur = int(cur)
+        new = max(int(floor), cur // 2)
+        opts[option] = new
+        return {option: new, 'was': cur}
+    return apply
+
+
+def scoped_ladder(opts):
+    """:func:`default_ladder` writing into ``opts`` (a caller-owned
+    dict) instead of the process-wide options.
+
+    This is the multi-tenant form: one request's OOM response must
+    reconfigure THAT request, not every other tenant sharing the
+    process.  The serving layer steps this ladder at admission
+    (:mod:`nbodykit_tpu.serve.admission`) and at runtime, then applies
+    the accumulated ``opts`` with :func:`nbodykit_tpu.option_scope`
+    around just that request's execution."""
+    return DegradationLadder([
+        ('fft_chunk_bytes/2',
+         _halve_scoped(opts, 'fft_chunk_bytes', 1 << 24)),
+        ('paint_chunk_size/2',
+         _halve_scoped(opts, 'paint_chunk_size', 1 << 18)),
+        ('fft_chunk_bytes/2',
+         _halve_scoped(opts, 'fft_chunk_bytes', 1 << 24)),
+        ('paint_chunk_size/2',
+         _halve_scoped(opts, 'paint_chunk_size', 1 << 18)),
+    ])
+
+
 class Supervisor(object):
     """Run callables under per-error-class policy.
 

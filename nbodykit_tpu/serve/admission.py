@@ -1,0 +1,142 @@
+"""Admission control: price first, schedule second.
+
+Every request is priced through :func:`nbodykit_tpu.pmesh.memory_plan`
+against its target sub-mesh's HBM budget (0.85 x ``hbm_bytes`` — the
+same 15% allocator margin the plan itself applies) BEFORE it can touch
+the queue.  Three outcomes:
+
+``admit``
+    the plan fits as requested — no configuration changes.
+``degrade``
+    the plan fits only after stepping the request down the resilience
+    degradation ladder (:func:`nbodykit_tpu.resilience.scoped_ladder`
+    — the per-request form that writes into a private options dict,
+    never the process-wide options).  The accumulated option overrides
+    ride on the decision and are applied with
+    :func:`nbodykit_tpu.option_scope` around just this request's
+    execution.
+``reject``
+    no rung makes it fit (or the geometry is impossible on the
+    sub-mesh).  The decision carries a STRUCTURED reason — machine
+    shape, never a bare string — quoting the peak and budget it was
+    judged by, so a 2048^3 request can never OOM a chip that a
+    thousand small tenants are sharing, and the caller learns exactly
+    why and by how much.
+"""
+
+from ..pmesh import memory_plan
+
+# decision states
+ADMIT = 'admit'
+DEGRADE = 'degrade'
+REJECT = 'reject'
+
+
+class AdmissionDecision(object):
+    """The priced verdict for one request on one sub-mesh."""
+
+    __slots__ = ('status', 'request_id', 'plan', 'reason', 'options',
+                 'rungs')
+
+    def __init__(self, status, request_id, plan=None, reason=None,
+                 options=None, rungs=None):
+        self.status = status
+        self.request_id = request_id
+        self.plan = plan
+        self.reason = reason
+        self.options = dict(options or {})
+        self.rungs = list(rungs or [])
+
+    @property
+    def admitted(self):
+        return self.status != REJECT
+
+    def to_dict(self):
+        out = {'status': self.status, 'request_id': self.request_id,
+               'options': dict(self.options),
+               'rungs': [r[0] for r in self.rungs]}
+        if self.reason is not None:
+            out['reason'] = dict(self.reason)
+        if self.plan is not None:
+            out['peak_bytes'] = self.plan.get('peak_bytes')
+            out['budget_bytes'] = self.plan.get('budget_bytes')
+        return out
+
+    def __repr__(self):
+        return 'AdmissionDecision(%s %s%s)' % (
+            self.status, self.request_id,
+            ' %s' % self.reason.get('code') if self.reason else '')
+
+
+def _plan(request, ndevices, hbm_bytes, paint_chunk=None):
+    method = request.paint_method
+    if method in (None, 'auto'):
+        # price what would actually run: the tune-cache resolution for
+        # this platform/shape (scheduler resolves the same way)
+        from ..tune.resolve import resolve_paint
+        method = resolve_paint(
+            nmesh=request.nmesh, npart=request.npart,
+            dtype=request.dtype, nproc=ndevices).get('paint_method',
+                                                     'scatter')
+        if method == 'auto':
+            method = 'scatter'
+    return memory_plan(request.nmesh, request.npart,
+                       ndevices=ndevices, dtype=request.dtype,
+                       resampler=request.resampler,
+                       paint_method=method, paint_chunk=paint_chunk,
+                       hbm_bytes=hbm_bytes)
+
+
+def admit(request, ndevices=1, hbm_bytes=16e9):
+    """Price ``request`` for an ``ndevices`` sub-mesh and decide.
+
+    Geometry that cannot run at all (Nmesh not divisible by the
+    sub-mesh, resampler support wider than a slab) rejects with
+    ``code='indivisible'``; an over-budget plan walks the scoped
+    degradation ladder and either admits degraded or rejects with
+    ``code='over_budget'`` quoting every rung it tried.
+    """
+    ndevices = max(int(ndevices), 1)
+    if request.nmesh % ndevices:
+        return AdmissionDecision(REJECT, request.request_id, reason={
+            'code': 'indivisible', 'nmesh': request.nmesh,
+            'ndevices': ndevices,
+            'detail': 'Nmesh must be divisible by the sub-mesh size'})
+    from ..ops.window import window_support
+    if window_support(request.resampler) > request.nmesh // ndevices:
+        return AdmissionDecision(REJECT, request.request_id, reason={
+            'code': 'indivisible', 'nmesh': request.nmesh,
+            'ndevices': ndevices, 'resampler': request.resampler,
+            'detail': 'resampler support exceeds the per-device slab'})
+
+    plan = _plan(request, ndevices, hbm_bytes)
+    if plan['fits']:
+        return AdmissionDecision(ADMIT, request.request_id, plan=plan)
+
+    # over budget as requested: step the request-scoped ladder until
+    # the re-priced plan fits or the rungs run out
+    from ..resilience import scoped_ladder
+    opts = {}
+    ladder = scoped_ladder(opts)
+    rungs = []
+    while True:
+        rung = ladder.step()
+        if rung is None:
+            break
+        rungs.append(rung)
+        plan2 = _plan(request, ndevices, hbm_bytes,
+                      paint_chunk=opts.get('paint_chunk_size'))
+        if plan2['fits']:
+            return AdmissionDecision(DEGRADE, request.request_id,
+                                     plan=plan2, options=opts,
+                                     rungs=rungs)
+    return AdmissionDecision(REJECT, request.request_id, plan=plan,
+                             reason={
+        'code': 'over_budget',
+        'peak_bytes': int(plan['peak_bytes']),
+        'budget_bytes': int(plan['budget_bytes']),
+        'hbm_bytes': int(hbm_bytes),
+        'nmesh': request.nmesh, 'npart': request.npart,
+        'ndevices': ndevices,
+        'rungs_tried': [r[0] for r in rungs],
+        'detail': 'peak exceeds 0.85*HBM on every degradation rung'})

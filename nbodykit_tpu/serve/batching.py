@@ -1,0 +1,78 @@
+"""Batching compatible small-mesh requests into one device program.
+
+A 1-device program is plain jax ops under ``vmap``, so N FFTPower
+requests with the SAME program key become one launch with a leading
+batch dimension over realization seeds.  The rules that keep this
+honest:
+
+- only ``batchable`` programs batch (multi-device programs are
+  shard_map, which vmap cannot wrap);
+- only requests admitted CLEAN batch — a degraded admission carries
+  per-request option overrides that would have to apply to the whole
+  launch, so degraded requests always run solo;
+- the collection window never blows a deadline: a batch closes as
+  soon as waiting any longer would make the tightest deadline in it
+  (or in the candidate) unservable, bounded by ``max_delay_s``;
+- seed counts are padded up to the next power of two (repeating the
+  last seed) so the compiled-shape catalog stays logarithmic in batch
+  size — pad results are discarded after the launch.
+"""
+
+from ..diagnostics import counter
+
+_PAD_LIMIT = 1 << 10
+
+
+class BatchPolicy(object):
+    """Knobs for the batching window.
+
+    ``max_batch`` — most requests per launch; ``max_delay_s`` — the
+    longest a ready request may wait for company.  ``max_delay_s=0``
+    disables coalescing entirely (every request runs solo).
+    """
+
+    __slots__ = ('max_batch', 'max_delay_s')
+
+    def __init__(self, max_batch=8, max_delay_s=0.05):
+        self.max_batch = max(int(max_batch), 1)
+        self.max_delay_s = max(float(max_delay_s), 0.0)
+
+
+def compatible(ticket, other, ndevices):
+    """True when ``other`` may join ``ticket``'s launch: identical
+    program key, both clean admissions (no per-request overrides)."""
+    if ticket.decision.options or other.decision.options:
+        return False
+    return ticket.request.program_key(ndevices) \
+        == other.request.program_key(ndevices)
+
+
+def pad_seeds(seeds):
+    """Pad the seed list up to the next power of two by repeating the
+    last seed; returns (padded, real_count).  Callers slice results to
+    ``real_count`` — the pads are pure compile-shape insulation."""
+    n = len(seeds)
+    cap = 1
+    while cap < n and cap < _PAD_LIMIT:
+        cap <<= 1
+    padded = list(seeds) + [seeds[-1]] * (cap - n)
+    if cap > n:
+        counter('serve.batch.padded').add(cap - n)
+    return padded, n
+
+
+def close_window(now, tickets, policy, opened_at):
+    """Should a batch opened at ``opened_at`` stop waiting for company?
+
+    True when the batch is full, coalescing is off, the window has
+    been open ``max_delay_s`` already, or waiting any longer would
+    push the tightest member deadline past its limit — the window
+    NEVER blows a deadline that admission accepted."""
+    if len(tickets) >= policy.max_batch:
+        return True
+    if policy.max_delay_s <= 0:
+        return True
+    if now - opened_at >= policy.max_delay_s:
+        return True
+    tightest = min(t.deadline_at for t in tickets)
+    return now + policy.max_delay_s >= tightest

@@ -1,0 +1,296 @@
+"""Placement + the warm program cache.
+
+Two jobs:
+
+**Programs stay warm.**  Each (program key, worker) pair builds its
+compiled analysis program exactly once, wrapped in
+:func:`~nbodykit_tpu.diagnostics.instrumented_jit` under a label keyed
+by shape class (``serve.fftpower.mesh64-part1e5``), so the
+``compile.<label>.misses`` / ``.hits`` counters are the PROOF that the
+second identical-shape request compiles nothing.  TUNE_CACHE.json
+winners are resolved once per (shape class, device count) — not once
+per request — behind a lock, and the resolution is memoized alongside
+the program.
+
+**Placement is cache-affine.**  A compiled XLA executable is bound to
+the devices it was built for, so the scheduler routes a request to the
+sub-mesh worker that already holds its warm program: affinity =
+``hash(program_key) % n_workers``.  An idle worker may still steal the
+globally best-ranked ticket (paying one compile to warm its own copy)
+rather than sit out a backlog — classic cache-aware scheduling with
+work stealing.  Ranking within a worker's view is priority (desc),
+deadline (asc), submission order (asc).
+
+The device programs themselves live here too: self-contained
+(seed -> spectrum) pipelines — uniform realization, paint, r2c,
+window compensation, integer-lattice shell binning — one per
+algorithm, modeled on bench.py's fused pipeline.  On a 1-device
+sub-mesh the program is plain jax ops (no shard_map), which is what
+makes it vmap-batchable (:mod:`.batching`); on a multi-device
+sub-mesh the same builder produces the shard_map form.
+"""
+
+import threading
+
+from ..diagnostics import counter, instrumented_jit
+from ..parallel.runtime import mesh_size
+
+BOX_SIZE = 1000.0
+
+
+def program_label(request):
+    """The instrumented-jit label for a request's program: keyed by
+    algorithm + shape class, NOT by exact shape — the granularity the
+    compile miss/hit counters aggregate at."""
+    return 'serve.%s.%s' % (request.algorithm.lower(),
+                            request.shape_class)
+
+
+# ---------------------------------------------------------------------------
+# device programs
+
+def _binned_power(pm, c, resampler, npart):
+    """Window-compensated, hermitian-weighted |delta_k|^2 binned onto
+    integer-lattice k shells (exact shell assignment — the same
+    integer-sqrt trick as bench.py's (k,mu) binning).  Returns
+    (k, P(k), nmodes) with nmesh//2 shells."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ops.window import compensation_transfer
+
+    nmesh = int(pm.Nmesh[0])
+    L = float(pm.BoxSize[0])
+    nbins = nmesh // 2
+    V = L ** 3
+
+    w = pm.k_list(dtype=jnp.float32, circular=True)
+    c = compensation_transfer(resampler, False)(w, c)
+    p3 = (jnp.abs(c) ** 2).astype(jnp.float32) * V
+    p3 = p3.at[0, 0, 0].set(0.0)
+
+    ix, iy, iz = pm.i_list_complex()
+    isq = ix * ix + iy * iy + iz * iz
+    r = jnp.sqrt(isq.astype(jnp.float32)).astype(jnp.int32)
+    r = r - (r * r > isq) + ((r + 1) * (r + 1) <= isq)
+    shell = jnp.minimum(r, nbins - 1)
+    wgt = jnp.broadcast_to(pm.hermitian_weights(jnp.float32), p3.shape)
+    flat = jnp.broadcast_to(shell, p3.shape).reshape(-1)
+    P = jnp.zeros(nbins, jnp.float32).at[flat].add(
+        (p3 * wgt).reshape(-1))
+    Nm = jnp.zeros(nbins, jnp.float32).at[flat].add(wgt.reshape(-1))
+    Nm0 = Nm.at[0].set(jnp.maximum(Nm[0] - 1.0, 0.0))  # drop DC mode
+    k = jnp.asarray(np.arange(nbins, dtype='f4')) \
+        * jnp.float32(2 * np.pi / L)
+    return k, P / jnp.maximum(Nm, 1.0), Nm0
+
+
+def _delta_c(pm, pos, resampler, npart):
+    """Painted overdensity in k space (forward-normalized r2c of
+    paint/nbar)."""
+    field, _ = pm.paint(pos, 1.0, resampler=resampler,
+                        return_dropped=True)
+    return pm.r2c(field / (float(npart) / pm.Ntot))
+
+
+def _uniform_pos(seed, npart, L):
+    import jax
+    import jax.numpy as jnp
+    return jax.random.uniform(jax.random.key(seed), (npart, 3),
+                              jnp.float32, 0.0, L)
+
+
+def _build_single(request, pm):
+    """The single-realization (seed -> (x, y, nmodes)) function for
+    one algorithm on one ParticleMesh."""
+    import jax.numpy as jnp
+    npart = request.npart
+    resampler = request.resampler
+    L = float(pm.BoxSize[0])
+
+    if request.algorithm == 'FFTPower':
+        def single(seed):
+            c = _delta_c(pm, _uniform_pos(seed, npart, L), resampler,
+                         npart)
+            return _binned_power(pm, c, resampler, npart)
+
+    elif request.algorithm == 'ConvolvedFFTPower':
+        # FKP-style: data minus an independent synthetic randoms
+        # realization (alpha = 1), monopole of the difference field
+        def single(seed):
+            data = _delta_c(pm, _uniform_pos(seed, npart, L),
+                            resampler, npart)
+            rand = _delta_c(pm, _uniform_pos(seed + 2 ** 20, npart, L),
+                            resampler, npart)
+            return _binned_power(pm, data - rand, resampler, npart)
+
+    else:  # FFTCorr: inverse transform of the 3-d power -> xi(r)
+        def single(seed):
+            import numpy as np
+            c = _delta_c(pm, _uniform_pos(seed, npart, L), resampler,
+                         npart)
+            from ..ops.window import compensation_transfer
+            w = pm.k_list(dtype=jnp.float32, circular=True)
+            c = compensation_transfer(resampler, False)(w, c)
+            p3c = (c * jnp.conj(c)).at[0, 0, 0].set(0.0)
+            xi3 = pm.c2r(p3c.astype(c.dtype))
+            # integer-lattice radial shells in real space (periodic
+            # signed distance per axis)
+            nmesh = int(pm.Nmesh[0])
+            nbins = nmesh // 2
+            ax = [jnp.asarray(np.minimum(np.arange(n),
+                                         n - np.arange(n))
+                              .astype('i4')).reshape(
+                      [1 if i != j else -1 for j in range(3)])
+                  for i, n in enumerate(int(v) for v in pm.Nmesh)]
+            dsq = ax[0] ** 2 + ax[1] ** 2 + ax[2] ** 2
+            r = jnp.sqrt(dsq.astype(jnp.float32)).astype(jnp.int32)
+            r = r - (r * r > dsq) + ((r + 1) * (r + 1) <= dsq)
+            shell = jnp.minimum(r, nbins - 1)
+            flat = jnp.broadcast_to(shell, xi3.shape).reshape(-1)
+            S = jnp.zeros(nbins, jnp.float32).at[flat].add(
+                xi3.astype(jnp.float32).reshape(-1))
+            Nm = jnp.zeros(nbins, jnp.float32).at[flat].add(
+                jnp.ones_like(flat, jnp.float32))
+            x = jnp.asarray(np.arange(nbins, dtype='f4')) \
+                * jnp.float32(L / nmesh)
+            return x, S / jnp.maximum(Nm, 1.0), Nm
+
+    return single
+
+
+class Program(object):
+    """One warm compiled analysis program, bound to one sub-mesh.
+
+    ``batchable`` programs (1-device sub-meshes: plain jax ops, no
+    shard_map) take a ``(B,)`` seed array and vmap over realizations;
+    multi-device programs take one seed per launch.
+    """
+
+    __slots__ = ('key', 'label', 'mesh', 'batchable', '_fn', '_device')
+
+    def __init__(self, request, mesh):
+        import jax
+        from ..pmesh import ParticleMesh
+        self.key = request.program_key(mesh_size(mesh))
+        self.label = program_label(request)
+        self.mesh = mesh
+        self.batchable = mesh_size(mesh) == 1
+        if self.batchable:
+            # comm-less plain-ops form — the ONLY form vmap can batch
+            # (shard_map is not vmappable); placement happens by
+            # committing the seed input to the sub-mesh's one device
+            self._device = mesh.devices.item() if mesh is not None \
+                else None
+            from ..parallel.runtime import use_mesh
+            with use_mesh(None):
+                pm = ParticleMesh(request.nmesh, BOX_SIZE,
+                                  request.dtype)
+            single = _build_single(request, pm)
+            # ProgramCache memoizes Program per (program_key, worker,
+            # opts) — __init__ runs once per cache entry, so this jit
+            # cache is long-lived, not per-call
+            # nbkl: disable=NBK202
+            self._fn = instrumented_jit(jax.vmap(single),
+                                        label=self.label)
+        else:
+            self._device = None
+            pm = ParticleMesh(request.nmesh, BOX_SIZE, request.dtype,
+                              comm=mesh)
+            # same memoized-by-ProgramCache lifetime as above
+            # nbkl: disable=NBK202
+            self._fn = instrumented_jit(_build_single(request, pm),
+                                        label=self.label)
+
+    def run(self, seeds):
+        """Execute for a list of seeds; returns per-seed
+        (x, y, nmodes) numpy triples.  Multi-device programs run the
+        seeds sequentially (their parallelism is the mesh); 1-device
+        programs run them as one vmapped launch."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        if self.batchable:
+            arr = jnp.asarray(list(seeds), jnp.uint32)
+            if self._device is not None:
+                arr = jax.device_put(arr, self._device)
+            x, y, nm = self._fn(arr)
+            x, y, nm = (np.asarray(v) for v in (x, y, nm))
+            return [(x[i], y[i], nm[i]) for i in range(len(seeds))]
+        out = []
+        from ..parallel.runtime import use_mesh
+        with use_mesh(self.mesh):
+            for s in seeds:
+                x, y, nm = self._fn(jnp.uint32(s))
+                out.append(tuple(np.asarray(v) for v in (x, y, nm)))
+        return out
+
+
+class ProgramCache(object):
+    """(program key, worker) -> warm :class:`Program`, plus the
+    once-per-shape-class tuned-option resolution.  All counters are
+    exported: ``serve.program.build`` / ``.reuse`` and
+    ``serve.tuned.resolve`` / ``.reuse`` tell the doctor how warm the
+    server is running."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs = {}
+        self._tuned = {}
+
+    def tuned_options(self, request, ndevices):
+        """The TUNE_CACHE.json resolution for this shape class —
+        memoized so a thousand same-class requests cost one lookup."""
+        key = (request.shape_class, request.dtype, int(ndevices))
+        with self._lock:
+            hit = self._tuned.get(key)
+        if hit is not None:
+            counter('serve.tuned.reuse').add(1)
+            return hit
+        from ..tune.resolve import resolve_paint
+        cfg = resolve_paint(nmesh=request.nmesh, npart=request.npart,
+                            dtype=request.dtype, nproc=ndevices)
+        cfg = {k: v for k, v in cfg.items()
+               if k in ('paint_method', 'paint_chunk_size',
+                        'paint_streams') and v is not None
+               and v != 'auto'}
+        counter('serve.tuned.resolve').add(1)
+        with self._lock:
+            self._tuned.setdefault(key, cfg)
+        return cfg
+
+    def get(self, request, mesh, worker, opts=None):
+        """The warm program for (request shape, worker), building it
+        on first use.  ``opts`` (request-scoped option overrides) are
+        part of the key: jit never sees Python option globals, so a
+        degraded run traced under smaller chunks must NOT share an
+        executable with the clean-option trace."""
+        key = (request.program_key(mesh_size(mesh)), int(worker),
+               tuple(sorted((opts or {}).items())))
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                counter('serve.program.reuse').add(1)
+                return prog
+            # build under the lock: two threads must not race the
+            # same (key, worker) into two instrumented wrappers
+            prog = Program(request, mesh)
+            self._programs[key] = prog
+        counter('serve.program.build').add(1)
+        return prog
+
+    def __len__(self):
+        with self._lock:
+            return len(self._programs)
+
+
+def affinity(request, ndevices, n_workers):
+    """The worker whose cache this request's program warms: stable
+    across the request stream (hash of the program key), so identical
+    shapes land where their executable already lives."""
+    return hash(request.program_key(ndevices)) % max(n_workers, 1)
+
+
+def rank(ticket):
+    """Sort key: higher priority first, then earliest deadline, then
+    submission order."""
+    return (-ticket.request.priority, ticket.deadline_at, ticket.seq)

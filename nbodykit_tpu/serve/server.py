@@ -1,0 +1,528 @@
+"""The analysis server: a persistent, admission-controlled loop.
+
+One :class:`AnalysisServer` owns the device fleet for its lifetime.
+Devices are partitioned into fixed sub-meshes exactly the way
+:meth:`nbodykit_tpu.batch.TaskManager.map` partitions them
+(:meth:`~nbodykit_tpu.batch.TaskManager.sub_meshes`), one long-lived
+worker thread pinned per sub-mesh.  A request's life:
+
+1. **submit** — priced by :func:`.admission.admit` against the
+   sub-mesh HBM budget; a rejection (or a full queue) returns a
+   structured :class:`RequestResult` immediately, never an exception.
+2. **queue** — a single bounded priority view shared by the workers;
+   ranking is priority desc, deadline asc, submission order.  Expired
+   tickets are evicted WITH a structured verdict at every pop — a
+   deadline miss is an answer, not a disappearance.
+3. **place** — cache-affine: the worker at
+   ``hash(program_key) % n_workers`` owns the warm executable; an
+   idle worker steals the best-ranked foreign ticket rather than
+   idle through a backlog.
+4. **batch** — compatible clean-admission FFTPower tickets on a
+   1-device sub-mesh coalesce into one vmap launch
+   (:mod:`.batching`), the collection window capped so no member's
+   deadline is blown.
+5. **run** — under a per-request :class:`~nbodykit_tpu.resilience.Supervisor`
+   (fault point ``serve.request.attempt``) with a request-scoped
+   degradation ladder writing into THAT request's option overrides,
+   applied via :func:`nbodykit_tpu.option_scope` — an injected tunnel
+   death retries/degrades one request; the other tenants never see it.
+   With a checkpoint store, finished work is saved before the
+   post-work fault point ``serve.request.work`` so a kill after
+   compute resumes instead of recomputing.
+6. **deliver** — every submitted request ends as exactly one
+   :class:`RequestResult`; ``lost`` (submitted minus resolved) is the
+   number the doctor FAILs on.
+
+Observability: ``serve.request`` spans, ``serve.*`` counters, a
+``serve.queue_depth`` gauge and a ``serve.latency_s`` histogram; the
+server additionally keeps the raw per-request latency list so
+:meth:`AnalysisServer.summary` can report real p50/p99 (the streaming
+histogram keeps only moments).
+"""
+
+import threading
+import time
+
+from ..diagnostics import counter, gauge, histogram, span
+from ..parallel.runtime import mesh_size
+from .admission import REJECT, admit
+from .batching import BatchPolicy, close_window, compatible, pad_seeds
+from .scheduler import ProgramCache, affinity, rank
+
+# terminal request states
+COMPLETED = 'completed'
+REJECTED = 'rejected'
+EVICTED = 'evicted'
+FAILED = 'failed'
+
+
+class RequestResult(object):
+    """The one terminal verdict every submitted request gets."""
+
+    __slots__ = ('request_id', 'status', 'x', 'y', 'nmodes', 'reason',
+                 'latency_s', 'events', 'options', 'admit_options',
+                 'batch_size', 'algorithm', 'shape_class')
+
+    def __init__(self, request_id, status, x=None, y=None, nmodes=None,
+                 reason=None, latency_s=None, events=None, options=None,
+                 admit_options=None, batch_size=0, algorithm=None,
+                 shape_class=None):
+        self.request_id = request_id
+        self.status = status
+        self.x, self.y, self.nmodes = x, y, nmodes
+        self.reason = reason
+        self.latency_s = latency_s
+        self.events = list(events or [])
+        # options: everything applied around the run (tuned winners +
+        # overrides); admit_options: ONLY what admission stepped down
+        self.options = dict(options or {})
+        self.admit_options = dict(admit_options or {})
+        self.batch_size = int(batch_size)
+        self.algorithm = algorithm
+        self.shape_class = shape_class
+
+    @property
+    def ok(self):
+        return self.status == COMPLETED
+
+    def event_count(self, kind):
+        return sum(1 for e in self.events if e.get('kind') == kind)
+
+    def to_dict(self):
+        out = {'request_id': self.request_id, 'status': self.status,
+               'latency_s': self.latency_s,
+               'batch_size': self.batch_size,
+               'algorithm': self.algorithm,
+               'shape_class': self.shape_class,
+               'options': dict(self.options),
+               'admit_options': dict(self.admit_options),
+               'events': list(self.events)}
+        if self.reason is not None:
+            out['reason'] = dict(self.reason)
+        return out
+
+    def __repr__(self):
+        return 'RequestResult(%s %s%s)' % (
+            self.request_id, self.status,
+            ' %.3fs' % self.latency_s if self.latency_s else '')
+
+
+class _Ticket(object):
+    __slots__ = ('request', 'decision', 'submitted_at', 'deadline_at',
+                 'seq', 'affinity', 'done', 'result')
+
+    def __init__(self, request, decision, submitted_at, seq, aff):
+        self.request = request
+        self.decision = decision
+        self.submitted_at = submitted_at
+        self.deadline_at = submitted_at + request.deadline_s
+        self.seq = seq
+        self.affinity = aff
+        self.done = threading.Event()
+        self.result = None
+
+
+class AnalysisServer(object):
+    """Multi-tenant FFTPower-as-a-service over the local device fleet.
+
+    Parameters
+    ----------
+    per_task : devices per sub-mesh (1 → every worker is a 1-device
+        batchable lane; the fleet is ``n_devices // per_task`` lanes)
+    max_queue : bound on waiting tickets; beyond it submissions get a
+        structured ``queue_full`` rejection
+    hbm_bytes : per-device HBM the admission controller prices against
+        (0.85x of this is the budget)
+    batch : :class:`.batching.BatchPolicy`
+    checkpoint : :class:`~nbodykit_tpu.resilience.CheckpointStore`
+        or None — per-request resume across mid-run faults
+    retry : :class:`~nbodykit_tpu.resilience.RetryPolicy` override
+    """
+
+    def __init__(self, per_task=1, max_queue=256, hbm_bytes=16e9,
+                 batch=None, checkpoint=None, retry=None):
+        from ..batch import TaskManager
+        from ..parallel.runtime import (CurrentMesh, cpu_mesh,
+                                        tpu_mesh, use_mesh)
+        from ..utils import is_mxu_backend
+        if CurrentMesh.get() is None:
+            # no ambient fleet mesh: serve the whole local device set
+            fleet = tpu_mesh() if is_mxu_backend() else cpu_mesh()
+            with use_mesh(fleet):
+                tm = TaskManager(per_task)
+                self.meshes = tm.sub_meshes()
+        else:
+            tm = TaskManager(per_task)
+            self.meshes = tm.sub_meshes()
+        if not self.meshes:
+            raise RuntimeError('no device sub-meshes to serve on')
+        self.ndevices = mesh_size(self.meshes[0])
+        self.max_queue = int(max_queue)
+        self.hbm_bytes = float(hbm_bytes)
+        self.batch = batch if batch is not None else BatchPolicy()
+        self.checkpoint = checkpoint
+        self.retry = retry
+        self.programs = ProgramCache()
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending = []
+        self._inflight = 0
+        self._seq = 0
+        self._stop = False
+        self._accepting = True
+        self._started_at = time.monotonic()
+
+        self.results = {}
+        self._latencies = []
+        self._submitted = 0
+
+        self._threads = [
+            threading.Thread(target=self._worker, args=(i,),
+                             name='serve-worker-%d' % i, daemon=True)
+            for i in range(len(self.meshes))]
+        for t in self._threads:
+            t.start()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def drain(self, timeout=None):
+        """Block until every accepted ticket has a result (the queue is
+        empty and no worker is mid-request).  Returns True when fully
+        drained."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cv:
+            while self._pending or self._inflight:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(timeout=left if left is not None
+                              else 0.5)
+        return True
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop accepting, optionally drain what was accepted, stop
+        the workers.  Idempotent — a second call is a no-op."""
+        with self._cv:
+            self._accepting = False
+            already = self._stop
+        if not already and drain:
+            self.drain(timeout=timeout)
+        with self._cv:
+            # anything still queued (drain=False or timed out) gets a
+            # structured eviction, never silence
+            for t in self._pending:
+                self._finish(t, RequestResult(
+                    t.request.request_id, EVICTED,
+                    reason={'code': 'shutdown',
+                            'detail': 'server shut down before run'},
+                    algorithm=t.request.algorithm,
+                    shape_class=t.request.shape_class))
+            self._pending = []
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, request):
+        """Admit (or reject) ``request`` and queue it.  Returns a
+        ticket whose ``.done`` event / ``.result`` carry the verdict;
+        rejections resolve immediately."""
+        now = time.monotonic()
+        counter('serve.submitted').add(1)
+        with self._lock:
+            self._submitted += 1
+            accepting = self._accepting
+            depth = len(self._pending)
+        aff = affinity(request, self.ndevices, len(self.meshes))
+        if not accepting:
+            return self._reject_now(request, now, {
+                'code': 'shutting_down',
+                'detail': 'server no longer accepting requests'})
+        if depth >= self.max_queue:
+            return self._reject_now(request, now, {
+                'code': 'queue_full', 'depth': depth,
+                'max_queue': self.max_queue,
+                'detail': 'bounded queue at capacity'})
+        decision = admit(request, ndevices=self.ndevices,
+                         hbm_bytes=self.hbm_bytes)
+        if decision.status == REJECT:
+            return self._reject_now(request, now, decision.reason,
+                                    decision=decision)
+        if decision.options:
+            counter('serve.admit_degraded').add(1)
+        ticket = None
+        with self._cv:
+            self._seq += 1
+            ticket = _Ticket(request, decision, now, self._seq, aff)
+            self._pending.append(ticket)
+            gauge('serve.queue_depth').set(len(self._pending))
+            self._cv.notify_all()
+        return ticket
+
+    def _reject_now(self, request, now, reason, decision=None):
+        counter('serve.rejected').add(1)
+        t = _Ticket(request, decision, now, -1, -1)
+        self._finish(t, RequestResult(
+            request.request_id, REJECTED, reason=reason,
+            latency_s=time.monotonic() - now,
+            algorithm=request.algorithm,
+            shape_class=request.shape_class))
+        return t
+
+    def wait(self, ticket, timeout=None):
+        """Block for a ticket's terminal :class:`RequestResult`."""
+        ticket.done.wait(timeout=timeout)
+        return ticket.result
+
+    # -- the worker loop --------------------------------------------------
+
+    def _finish(self, ticket, result):
+        ticket.result = result
+        self.results[result.request_id] = result
+        if result.status == COMPLETED:
+            counter('serve.completed').add(1)
+            if result.latency_s is not None:
+                histogram('serve.latency_s').observe(result.latency_s)
+                self._latencies.append(result.latency_s)
+        elif result.status == FAILED:
+            counter('serve.failed').add(1)
+        elif result.status == EVICTED:
+            counter('serve.evicted').add(1)
+        ticket.done.set()
+
+    def _evict_expired_locked(self, now):
+        live = []
+        for t in self._pending:
+            if now >= t.deadline_at:
+                self._finish(t, RequestResult(
+                    t.request.request_id, EVICTED,
+                    reason={'code': 'deadline',
+                            'deadline_s': t.request.deadline_s,
+                            'waited_s': round(now - t.submitted_at, 3),
+                            'detail': 'deadline passed while queued'},
+                    latency_s=now - t.submitted_at,
+                    algorithm=t.request.algorithm,
+                    shape_class=t.request.shape_class))
+            else:
+                live.append(t)
+        self._pending = live
+
+    def _pick_locked(self, wi, now):
+        """Best ticket for worker ``wi``: its own affinity first, else
+        steal the globally best-ranked one."""
+        mine = [t for t in self._pending if t.affinity == wi]
+        pool = mine or self._pending
+        if not pool:
+            return None
+        best = min(pool, key=rank)
+        self._pending.remove(best)
+        return best
+
+    def _batchable(self, ticket):
+        return (self.ndevices == 1
+                and ticket.request.algorithm == 'FFTPower'
+                and not ticket.decision.options)
+
+    def _collect_locked(self, leader, opened_at):
+        """Grow the leader's batch from compatible pending tickets,
+        holding the coalescing window open at most ``max_delay_s`` and
+        never past any member's deadline."""
+        group = [leader]
+        if not self._batchable(leader) \
+                or self.batch.max_batch <= 1 \
+                or self.batch.max_delay_s <= 0:
+            return group
+        while True:
+            for t in list(self._pending):
+                if len(group) >= self.batch.max_batch:
+                    break
+                if self._batchable(t) and compatible(leader, t,
+                                                     self.ndevices):
+                    self._pending.remove(t)
+                    group.append(t)
+            now = time.monotonic()
+            if self._stop or close_window(now, group, self.batch,
+                                          opened_at):
+                return group
+            self._cv.wait(timeout=self.batch.max_delay_s / 4 or 0.01)
+
+    def _worker(self, wi):
+        mesh = self.meshes[wi]
+        while True:
+            with self._cv:
+                while True:
+                    if self._stop:
+                        return
+                    now = time.monotonic()
+                    self._evict_expired_locked(now)
+                    ticket = self._pick_locked(wi, now)
+                    if ticket is not None:
+                        break
+                    self._cv.wait(timeout=0.25)
+                group = self._collect_locked(ticket, time.monotonic())
+                self._inflight += 1
+                gauge('serve.queue_depth').set(len(self._pending))
+            try:
+                self._run_group(group, mesh, wi)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    # -- execution --------------------------------------------------------
+
+    def _run_group(self, group, mesh, wi):
+        import nbodykit_tpu
+        from ..resilience import Supervisor
+        from ..resilience.faults import fault_point
+        from ..resilience.supervise import scoped_ladder
+
+        leader = group[0]
+        req = leader.request
+        if len(group) > 1:
+            counter('serve.batched').add(len(group))
+        # one mutable option dict per run: admission's rungs seed it,
+        # the supervisor's runtime ladder steps it further on OOM —
+        # both scoped to this run, applied only inside option_scope
+        opts = dict(self.programs.tuned_options(req, self.ndevices))
+        opts.update(leader.decision.options or {})
+        sup = Supervisor('serve.request', policy=self.retry,
+                         ladder=scoped_ladder(opts),
+                         checkpoint=self.checkpoint)
+        seeds = [t.request.seed for t in group]
+        rid = req.request_id
+
+        def work():
+            got = sup.resume(rid, validate=lambda s:
+                             s.get('seeds') == list(seeds))
+            if got is not None:
+                state, arrays = got
+                n = len(seeds)
+                return [(arrays['x'][i], arrays['y'][i],
+                         arrays['nm'][i]) for i in range(n)]
+            with nbodykit_tpu.option_scope(**opts):
+                prog = self.programs.get(req, mesh, wi, opts=opts)
+                if prog.batchable:
+                    padded, n = pad_seeds(seeds)
+                    out = prog.run(padded)[:n]
+                else:
+                    out = prog.run(seeds)
+            import numpy as np
+            sup.save(rid, {'seeds': list(seeds)},
+                     arrays={'x': np.array([o[0] for o in out]),
+                             'y': np.array([o[1] for o in out]),
+                             'nm': np.array([o[2] for o in out])})
+            # the post-work fault point: a kill injected here lands
+            # AFTER the checkpoint, so the retry resumes, not recomputes
+            fault_point('serve.request.work')
+            return out
+
+        now = time.monotonic()
+        with span('serve.request', request_id=rid,
+                  algorithm=req.algorithm, shape_class=req.shape_class,
+                  batch=len(group), worker=wi):
+            try:
+                out = sup.run(work)
+            except Exception as e:
+                done_at = time.monotonic()
+                for t in group:
+                    self._finish(t, RequestResult(
+                        t.request.request_id, FAILED,
+                        reason={'code': 'execution',
+                                'error': str(e)[:500],
+                                'type': type(e).__name__},
+                        latency_s=done_at - t.submitted_at,
+                        events=sup.events, options=opts,
+                        admit_options=t.decision.options,
+                        batch_size=len(group),
+                        algorithm=t.request.algorithm,
+                        shape_class=t.request.shape_class))
+                return
+        sup.done(rid)
+        if sup.events:
+            counter('serve.fault_degraded').add(1)
+        done_at = time.monotonic()
+        for t, (x, y, nm) in zip(group, out):
+            self._finish(t, RequestResult(
+                t.request.request_id, COMPLETED, x=x, y=y, nmodes=nm,
+                latency_s=done_at - t.submitted_at, events=sup.events,
+                options=opts, admit_options=t.decision.options,
+                batch_size=len(group),
+                algorithm=t.request.algorithm,
+                shape_class=t.request.shape_class))
+
+    # -- reporting --------------------------------------------------------
+
+    @staticmethod
+    def _pctile(values, q):
+        if not values:
+            return None
+        vs = sorted(values)
+        idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+        return vs[idx]
+
+    def summary(self):
+        """The serving scorecard: totals by terminal status, real
+        p50/p99 latency, throughput, degradation provenance
+        (``admit_degraded`` = stepped down at pricing;
+        ``fault_degraded`` = supervisor events at runtime), and
+        ``lost`` — submitted requests with NO structured verdict,
+        the number that must be zero."""
+        with self._lock:
+            results = list(self.results.values())
+            lat = list(self._latencies)
+            submitted = self._submitted
+            queued = len(self._pending)
+            inflight = self._inflight
+        by_status = {}
+        for r in results:
+            by_status[r.status] = by_status.get(r.status, 0) + 1
+        by_class = {}
+        for r in results:
+            if r.status == COMPLETED and r.latency_s is not None:
+                by_class.setdefault(r.shape_class, []).append(
+                    r.latency_s)
+        completed = by_status.get(COMPLETED, 0)
+        wall = max(time.monotonic() - self._started_at, 1e-9)
+        retried = sum(1 for r in results
+                      if r.event_count('retries'))
+        degraded = sum(1 for r in results
+                       if r.event_count('degradations'))
+        resumed = sum(1 for r in results if r.event_count('resumes'))
+        admit_deg = sum(1 for r in results if r.admit_options)
+        return {
+            'submitted': submitted,
+            'resolved': len(results),
+            'lost': submitted - len(results) - queued - inflight,
+            'completed': completed,
+            'rejected': by_status.get(REJECTED, 0),
+            'evicted': by_status.get(EVICTED, 0),
+            'failed': by_status.get(FAILED, 0),
+            'retried': retried,
+            'fault_degraded': degraded,
+            'resumed': resumed,
+            'admit_degraded': admit_deg,
+            'p50_s': self._pctile(lat, 0.50),
+            'p99_s': self._pctile(lat, 0.99),
+            'mean_s': sum(lat) / len(lat) if lat else None,
+            'rps': completed / wall,
+            'wall_s': wall,
+            'workers': len(self.meshes),
+            'ndevices_per_worker': self.ndevices,
+            'programs': len(self.programs),
+            'by_class': {k: {'n': len(v),
+                             'p50_s': self._pctile(v, 0.50),
+                             'p99_s': self._pctile(v, 0.99)}
+                         for k, v in sorted(by_class.items())},
+        }

@@ -1,0 +1,91 @@
+"""Synthetic multi-tenant request traces.
+
+A serving benchmark is only as honest as its load: this generator
+produces the 1k-request trace the bench/regress pipeline replays —
+deterministic from a seed, with the shape statistics of a real
+multi-tenant analysis service:
+
+- a small catalog of (algorithm, nmesh, npart) shapes with Zipf-ish
+  popularity (probability ~ 1/(rank+1)): a few hot shapes dominate —
+  the regime where the warm program cache and vmap batching pay —
+  with a long tail of cold shapes that each eat one compile;
+- mostly ``FFTPower`` (the batchable algorithm), a minority of
+  ``ConvolvedFFTPower`` / ``FFTCorr``;
+- mixed priorities and deadlines, plus a slice of deliberately
+  hopeless requests (huge mesh, or sub-millisecond deadline) so the
+  admission controller and the deadline evictor have real work.
+
+Everything derives from ``random.Random(seed)`` — the same seed is
+the same trace on every platform, which is what lets BENCH_r*.json
+rounds compare against each other.
+"""
+
+import random
+
+from .request import AnalysisRequest
+
+# the shape catalog, hot-first (Zipf rank order).  Small meshes: the
+# serving benchmark measures scheduling/caching/batching overheads on
+# an 8-device CPU mesh, not FFT throughput.
+_CATALOG = (
+    ('FFTPower', 32, 20000),
+    ('FFTPower', 64, 50000),
+    ('FFTPower', 32, 50000),
+    ('FFTCorr', 32, 20000),
+    ('FFTPower', 48, 30000),
+    ('ConvolvedFFTPower', 32, 20000),
+    ('FFTPower', 64, 100000),
+    ('FFTCorr', 64, 50000),
+)
+
+
+def generate_trace(n, seed=0, deadline_s=120.0, reject_fraction=0.02,
+                   evict_fraction=0.0):
+    """``n`` deterministic :class:`AnalysisRequest`\\ s.
+
+    ``reject_fraction`` of them ask for an absurd mesh (2048³ on one
+    device) to exercise structured rejection; ``evict_fraction`` carry
+    a deadline already impossible at submission to exercise eviction.
+    IDs are ``trace-NNNNN`` in submission order.
+    """
+    rng = random.Random(seed)
+    weights = [1.0 / (rank + 1) for rank in range(len(_CATALOG))]
+    out = []
+    for i in range(int(n)):
+        rid = 'trace-%05d' % i
+        u = rng.random()
+        if u < reject_fraction:
+            out.append(AnalysisRequest(
+                algorithm='FFTPower', nmesh=2048, npart=10 ** 9,
+                dtype='f4', seed=rng.randrange(2 ** 20),
+                deadline_s=deadline_s, priority=0, request_id=rid))
+            continue
+        algo, nmesh, npart = rng.choices(_CATALOG,
+                                         weights=weights)[0]
+        dl = deadline_s
+        if evict_fraction and u < reject_fraction + evict_fraction:
+            dl = 1e-3
+        out.append(AnalysisRequest(
+            algorithm=algo, nmesh=nmesh, npart=npart, dtype='f4',
+            seed=rng.randrange(2 ** 20), deadline_s=dl,
+            priority=rng.choice((0, 0, 0, 1, 1, 2)),
+            request_id=rid))
+    return out
+
+
+def replay(server, trace, interarrival_s=0.0, seed=0):
+    """Submit a trace to ``server`` and wait for every verdict.
+
+    ``interarrival_s > 0`` adds exponential(ish) spacing from the same
+    deterministic RNG — 0 is closed-loop slam.  Returns the ticket
+    list (order matches the trace)."""
+    import time
+    rng = random.Random(seed)
+    tickets = []
+    for req in trace:
+        tickets.append(server.submit(req))
+        if interarrival_s > 0:
+            time.sleep(rng.expovariate(1.0 / interarrival_s))
+    for t in tickets:
+        t.done.wait()
+    return tickets

@@ -28,6 +28,7 @@ import json
 import math
 import os
 import re
+import threading
 import time
 
 import numpy as np
@@ -202,8 +203,13 @@ def cache_path():
     return str(configured) if configured else default_cache_path()
 
 
-# mtime-cached loads: dispatch-time resolution costs one stat
+# mtime-cached loads: dispatch-time resolution costs one stat.  The
+# memo is hit concurrently by every serve worker thread resolving
+# tuned options per request (nbodykit_tpu.serve), so reads and
+# writes go through one lock — a dict half-updated by a racing
+# loader must never be visible.
 _loaded = {}            # path -> (mtime_ns, size, entries)
+_loaded_lock = threading.Lock()
 
 
 def _load_entries(path):
@@ -212,21 +218,27 @@ def _load_entries(path):
     except OSError:
         return {}
     tag = (st.st_mtime_ns, st.st_size)
-    hit = _loaded.get(path)
-    if hit is not None and hit[0] == tag:
-        return hit[1]
+    with _loaded_lock:
+        hit = _loaded.get(path)
+        if hit is not None and hit[0] == tag:
+            return hit[1]
+    # parse outside the lock (a slow disk must not serialize every
+    # dispatch); concurrent loaders may parse twice, last one wins —
+    # both parsed the same (mtime, size) snapshot
     try:
         with open(path) as f:
             entries = dict(json.load(f).get('entries') or {})
     except (OSError, ValueError):
         entries = {}
-    _loaded[path] = (tag, entries)
+    with _loaded_lock:
+        _loaded[path] = (tag, entries)
     return entries
 
 
 def reset_cache_memo():
     """Drop the mtime memo (test isolation)."""
-    _loaded.clear()
+    with _loaded_lock:
+        _loaded.clear()
 
 
 class TuneCache(object):
@@ -297,7 +309,8 @@ class TuneCache(object):
         data['entries'][key] = entry
         atomic_write(self.path,
                      json.dumps(data, indent=1, sort_keys=True))
-        _loaded.pop(self.path, None)
+        with _loaded_lock:
+            _loaded.pop(self.path, None)
         return key
 
 

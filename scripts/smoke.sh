@@ -194,12 +194,37 @@ assert not [f for f in os.listdir(os.path.join(tmp, 'CKPT'))
 print('resume smoke OK: %(metric)s resumed -> %(value)s s' % rec)
 EOF
 
+# multi-tenant serve gate (docs/SERVING.md): a 24-request synthetic
+# trace with a mid-request tunnel death injected at the 3rd attempt —
+# exactly one request retries (batching disabled so the fault lands on
+# a single tenant), nothing is lost, every submission gets a structured
+# verdict, p99 is recorded
+echo "== serve trace gate (24 req, injected fault) =="
+env JAX_NUM_CPU_DEVICES=2 \
+    NBKIT_FAULTS='serve.request.attempt@3:unavailable' \
+    python bench.py --serve-trace 24 1 1 0 > "$SMOKE_TMP/serve.json"
+python - "$SMOKE_TMP" <<'EOF'
+import json, os, sys
+rec = json.loads(open(os.path.join(
+    sys.argv[1], 'serve.json')).read().strip().splitlines()[-1])
+assert rec['lost'] == 0, rec
+assert rec['retried'] == 1, rec
+assert rec['p99_s'] > 0, rec
+resolved = (rec['completed'] + rec['rejected'] + rec['evicted']
+            + rec['failed'])
+assert resolved == rec['submitted'], rec
+assert rec['faults_injected'], rec
+print('serve gate OK: %(completed)d/%(submitted)d completed, '
+      'retried=%(retried)d lost=%(lost)d p99=%(p99_s).3fs' % rec)
+EOF
+
 echo "== tier-1 fast subset =="
 python -m pytest \
     tests/test_diagnostics.py \
     tests/test_diagnostics_analyze.py \
     tests/test_resilience.py \
     tests/test_tune.py \
+    tests/test_serve.py \
     tests/test_lint.py \
     tests/test_lint_dataflow.py \
     tests/test_jax_compat.py \
