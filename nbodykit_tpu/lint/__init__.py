@@ -16,11 +16,15 @@ Since v2 the linter is **interprocedural**: ``callgraph.py`` builds a
 project-wide call graph (cross-module, resolving the ``jax.jit`` /
 ``instrumented_jit`` / ``shard_map`` / ``lru_cache``-builder wrapper
 idioms, including the lru-cached program-tuple unpacking in dfft.py),
-and two analysis families run on it — ``collectives.py`` enumerates
-per-path collective sequences (NBK103 deadlock detection) and
+and three analysis families run on it — ``collectives.py`` enumerates
+per-path collective sequences (NBK103 deadlock detection),
 ``sizes.py`` tracks full-mesh-sized values through assignments and
 call boundaries with a donation-aware symbolic peak model (NBK5xx,
-``--memory-report``).
+``--memory-report``), and ``shardflow.py``/``dtypeflow.py`` run
+abstract interpretation over a joint (sharding x dtype) lattice —
+PartitionSpec facts across shard_map/jit boundaries (NBK6xx,
+``--shard-report``) and dtype-width facts through casts, allocators
+and return summaries (NBK7xx).
 
 Rule families (full catalog: ``nbodykit-tpu-lint --list-rules``,
 docs/LINT.md):
@@ -39,6 +43,13 @@ NBK4xx   trace safety — ``.item()``/``float()``/``np.asarray`` /
 NBK5xx   memory/donation — mesh-sized jit arguments without
          ``donate_argnums``, donations defeated by live caller
          references, symbolic peaks over the ``memory_plan`` budget
+NBK6xx   sharding-flow — implicit reshards at shard_map boundaries,
+         replicated mesh-sized outputs, in/out_specs arity
+         mismatches, collectives naming axes the mesh lacks
+NBK7xx   precision-flow — narrow collective payloads consumed raw,
+         bf16 accumulation without compensated summation,
+         mesh-promoting mixed-dtype arithmetic, value-range-proved
+         int32 index overflow (the NBK302 upgrade)
 =======  ==========================================================
 
 Workflow: ``nbodykit-tpu-lint --baseline lint_baseline.json`` exits
@@ -64,4 +75,7 @@ from .baseline import (apply_baseline, build_baseline,  # noqa: F401
 from .report import (family_of, family_stats,  # noqa: F401
                      render_findings, render_json, render_stats,
                      render_summary, summarize_findings)
-from .cli import main, run_lint, run_memory_report  # noqa: F401
+from .shardflow import (shard_report,  # noqa: F401
+                        render_shard_report)
+from .cli import (main, run_lint, run_memory_report,  # noqa: F401
+                  run_shard_report)

@@ -8,6 +8,8 @@
     nbodykit-tpu-lint --stats --baseline lint_baseline.json
     nbodykit-tpu-lint --memory-report --nmesh 1024 bench.py
     nbodykit-tpu-lint --nmesh 1024 --hbm-gb 16    # NBK503 gating
+    nbodykit-tpu-lint --shard-report nbodykit_tpu/
+    nbodykit-tpu-lint --explain NBK601
 
 Exit codes: 0 — no non-baselined findings; 1 — new findings (the CI
 gate); 2 — usage / IO error.  ``scripts/smoke.sh`` and
@@ -86,6 +88,21 @@ def run_memory_report(paths, config, npart=None, out=None):
     return report
 
 
+def run_shard_report(paths, out=None):
+    """--shard-report: every shard_map boundary with its resolved
+    mesh axes and in/out specs (no config needed — specs are
+    structural facts)."""
+    from .shardflow import shard_report, render_shard_report
+    out = out if out is not None else sys.stdout
+    project, parse_findings = build_project(paths)
+    for f in parse_findings:
+        print('nbodykit-tpu-lint: %s: %s' % (f.path, f.message),
+              file=sys.stderr)
+    report = shard_report(project)
+    out.write(render_shard_report(report))
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='nbodykit-tpu-lint',
@@ -114,6 +131,12 @@ def main(argv=None):
                     help='omit the fix-hint lines')
     ap.add_argument('--list-rules', action='store_true',
                     help='print the rule catalog and exit')
+    ap.add_argument('--explain', metavar='CODE', default=None,
+                    help='print a rule\'s rationale, example and fix '
+                         'pattern and exit (e.g. --explain NBK601)')
+    ap.add_argument('--shard-report', action='store_true',
+                    help='print the shard_map boundary table (mesh '
+                         'axes, in/out specs) instead of linting')
     ap.add_argument('--memory-report', action='store_true',
                     help='print the per-function symbolic peak table '
                          'for the declared config (requires --nmesh) '
@@ -136,6 +159,17 @@ def main(argv=None):
         sys.stdout.write(render_rule_catalog())
         return 0
 
+    if args.explain:
+        from .explain import render_explanation
+        try:
+            sys.stdout.write(render_explanation(
+                args.explain.strip().upper()))
+        except KeyError as e:
+            print('nbodykit-tpu-lint: %s' % e.args[0],
+                  file=sys.stderr)
+            return 2
+        return 0
+
     select = [s.strip().upper() for s in args.select.split(',')
               if s.strip()] if args.select else None
     paths = args.paths or default_targets()
@@ -144,6 +178,10 @@ def main(argv=None):
             print('nbodykit-tpu-lint: no such path: %s' % p,
                   file=sys.stderr)
             return 2
+
+    if args.shard_report:
+        run_shard_report(paths)
+        return 0
 
     config = _memory_config_from(args)
     if args.memory_report:

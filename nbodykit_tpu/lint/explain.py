@@ -1,0 +1,154 @@
+"""``nbodykit-tpu-lint --explain NBKxxx``.
+
+Each rule's rationale already lives on its rule function as the
+docstring (rules.py); this module adds the teaching half — a minimal
+flagged example and the fix pattern — and renders the three together.
+Keeping examples here, out of rules.py, keeps the rule bodies lean
+and gives the smoke/docs a single source for "what does this code
+mean"."""
+
+import textwrap
+
+from .rules import RULES
+
+#: code -> (flagged example, fixed example).  Examples are minimal —
+#: the shapes the fixture tests use, not real call sites.
+EXAMPLES = {
+    'NBK101': (
+        "jax.lax.psum(x, axis_name='dev')   # no mesh/shard_map\n"
+        "                                   # binds 'dev' here",
+        "with mesh:  # or inside shard_map(..., mesh=mesh)\n"
+        "    jax.lax.psum(x, axis_name='dev')"),
+    'NBK102': (
+        "if jax.process_index() == 0:\n"
+        "    jax.lax.psum(x, 'dev')    # ranks disagree -> deadlock",
+        "s = jax.lax.psum(x, 'dev')    # every rank participates\n"
+        "if jax.process_index() == 0:\n"
+        "    log(s)"),
+    'NBK103': (
+        "if is_even_rank:\n"
+        "    psum(a, 'dev'); pmax(b, 'dev')\n"
+        "else:\n"
+        "    pmax(b, 'dev'); psum(a, 'dev')   # order diverges",
+        "psum(a, 'dev'); pmax(b, 'dev')   # one order, all ranks"),
+    'NBK201': (
+        "for k in ks:\n"
+        "    f = jax.jit(lambda x: x * k)   # recompiles every item",
+        "f = jax.jit(lambda x, k: x * k)    # compile once\n"
+        "for k in ks:\n"
+        "    f(x, k)"),
+    'NBK202': (
+        "jax.jit(partial(step, cfg))(x)   # fresh fn obj = no cache",
+        "step_j = jax.jit(partial(step, cfg))   # module level\n"
+        "step_j(x)"),
+    'NBK203': (
+        "jax.jit(f, static_argnums=(1,))(x, [1, 2])  # list unhashable",
+        "jax.jit(f, static_argnums=(1,))(x, (1, 2))  # tuple hashes"),
+    'NBK301': (
+        "jnp.asarray(pos, dtype='f8')   # TPU silently computes f32",
+        "jnp.asarray(pos, dtype='f4')   # say what runs, or enable\n"
+        "                               # x64 deliberately"),
+    'NBK302': (
+        "flat = (ix * n + iy) * n + iz   # i4: overflows at n>=1291",
+        "flat = flat_index_i64(ix, iy, iz, n)  # or prove n bounded"),
+    'NBK401': (
+        "if float(err) < tol:   # host sync inside jit -> tracer leak",
+        "jax.lax.cond(err < tol, ...)   # stay on device"),
+    'NBK402': (
+        "key = np.random.rand()   # baked constant under jit",
+        "key = jax.random.uniform(k)   # traced, fresh per call"),
+    'NBK501': (
+        "out = step_j(mesh_buf)        # input+output both live",
+        "step_j = jax.jit(step, donate_argnums=(0,))\n"
+        "out = step_j(mesh_buf)        # XLA aliases in place"),
+    'NBK502': (
+        "out = step_j(mesh_buf)   # donated...\n"
+        "use(mesh_buf)            # ...but still read: not aliased",
+        "tmp, mesh_buf = mesh_buf, None   # drop the reference\n"
+        "out = step_j(tmp)"),
+    'NBK503': (
+        "def fused(x):        # 4 mesh units live at peak\n"
+        "    return c(b(a(x)))",
+        "a_j, b_j, c_j = (jax.jit(f, donate_argnums=(0,))\n"
+        "                 for f in (a, b, c))   # staged ladder,\n"
+        "x = a_j(x); x = b_j(x); x = c_j(x)     # 2 units"),
+    'NBK601': (
+        "y = sharded_producer(x)           # returns P('dev', None)\n"
+        "g = shard_map(f, mesh=mesh,\n"
+        "              in_specs=(P(None, 'dev'),),  # reshard hides\n"
+        "              out_specs=P('dev', None))    # an all_to_all\n"
+        "g(y)",
+        "in_specs=(P('dev', None),)   # match the producer, or make\n"
+        "# the transpose an explicit, tunable stage"),
+    'NBK602': (
+        "shard_map(paint, mesh=mesh, in_specs=(P('dev'),),\n"
+        "          out_specs=P())   # full mesh gathered per device",
+        "out_specs=P('dev')         # keep the output sharded, or\n"
+        "# psum() inside the body if a replicated scalar is meant"),
+    'NBK603': (
+        "shard_map(lambda a, b: a + b, mesh=mesh,\n"
+        "          in_specs=(P('dev'),),    # 1 spec, 2 params\n"
+        "          out_specs=P('dev'))",
+        "in_specs=(P('dev'), P('dev'))      # one spec per param"),
+    'NBK604': (
+        "g = shard_map(body, mesh=pencil_mesh(),  # axes ('x','y')\n"
+        "              in_specs=(P('x'),), out_specs=P('x'))\n"
+        "def body(a):\n"
+        "    return jax.lax.psum(a, 'dev')   # 'dev' not in mesh",
+        "return jax.lax.psum(a, 'x')   # an axis the mesh defines"),
+    'NBK701': (
+        "y = jax.lax.all_to_all(x.astype(jnp.bfloat16),\n"
+        "                       'dev', 0, 0)\n"
+        "acc = acc + y                  # bf16 error propagates",
+        "y = jax.lax.all_to_all(x.astype(jnp.bfloat16), 'dev',\n"
+        "                       0, 0).astype(jnp.float32)\n"
+        "# bf16 on the wire, f32 in the math"),
+    'NBK702': (
+        "acc = jnp.zeros(n, jnp.bfloat16)\n"
+        "for c in chunks:\n"
+        "    acc = acc + c          # stops absorbing mass ~256 adds",
+        "acc = jnp.zeros(n, jnp.float32)   # accumulate wide, cast\n"
+        "...                               # once at the end; or the\n"
+        "hi = (acc + w).astype(jnp.bfloat16)       # two-sum hi/lo\n"
+        "lo = (w - hi.astype(jnp.float32)) ...     # residual split"),
+    'NBK703': (
+        "mesh16 = paint(pos).astype(jnp.bfloat16)\n"
+        "out = mesh16 * kernel_f32    # full-mesh f32 copy appears",
+        "out = mesh16 * kernel_f32.astype(jnp.bfloat16)\n"
+        "# cast the small side down; widen per-chunk if f32 math\n"
+        "# is required"),
+    'NBK704': (
+        "flat = (ix * N + iy) * N + iz   # i4, N unbounded, no guard",
+        "if N ** 3 > np.iinfo(np.int32).max:   # trace-time guard\n"
+        "    raise ValueError('index overflows int32')\n"
+        "# or bound N with a module constant so the range is\n"
+        "# provable < 2**31 (then the rule is silent by proof)"),
+}
+
+
+def render_explanation(code):
+    """The --explain document for one code; KeyError with a helpful
+    message for unknown codes."""
+    if code not in RULES:
+        raise KeyError(
+            'unknown rule %s — see --list-rules for the catalog'
+            % code)
+    summary, func = RULES[code]
+    out = ['%s — %s' % (code, summary), '']
+    doc = textwrap.dedent('    ' + (func.__doc__ or '')).strip()
+    if doc:
+        out.append('rationale:')
+        out.extend('  ' + ln for ln in
+                   textwrap.fill(' '.join(doc.split()),
+                                 width=68).splitlines())
+        out.append('')
+    ex = EXAMPLES.get(code)
+    if ex is not None:
+        flagged, fixed = ex
+        out.append('flagged:')
+        out.extend('  ' + ln for ln in flagged.splitlines())
+        out.append('')
+        out.append('fix pattern:')
+        out.extend('  ' + ln for ln in fixed.splitlines())
+        out.append('')
+    return '\n'.join(out).rstrip() + '\n'

@@ -12,6 +12,8 @@ FAMILIES = collections.OrderedDict([
     ('NBK3', 'precision'),
     ('NBK4', 'trace safety'),
     ('NBK5', 'memory/donation'),
+    ('NBK6', 'sharding-flow'),
+    ('NBK7', 'precision-flow'),
     ('NBK0', 'tool'),
 ])
 

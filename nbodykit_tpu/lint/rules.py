@@ -689,3 +689,204 @@ def over_memory_budget(ctx):
             'staged-ladder pattern), or chunk the stage; '
             '--memory-report prints the full per-function table '
             '(unit = %.2f GB)' % (unit_bytes(config) / 1e9))
+
+
+# ---------------------------------------------------------------------------
+# NBK6xx — interprocedural sharding-flow analysis (shardflow.py)
+
+
+@rule('NBK601', 'mesh-sized value crosses a shard_map boundary with '
+                'a different spec than it carries')
+def implicit_reshard(ctx):
+    """A value produced under one PartitionSpec and fed to a
+    shard_map whose in_specs declare another is silently resharded at
+    the boundary — XLA inserts the all_to_all/all_gather for you, and
+    at mesh scale that hidden collective costs more than the kernel
+    it feeds.  Facts flow interprocedurally (boundary results, callee
+    return summaries); unresolved specs stay silent."""
+    from .shardflow import find_reshards, render_spec
+    for call, name, have, want in find_reshards(ctx):
+        yield _finding(
+            'NBK601', ctx, call,
+            'mesh-sized %r carries spec %s but this boundary\'s '
+            'in_specs declare %s — an implicit reshard (hidden '
+            'all_to_all/all_gather) at the shard_map edge'
+            % (name, render_spec(have), render_spec(want)),
+            'align the producer\'s out_specs with this consumer\'s '
+            'in_specs, or reshard explicitly (jax.lax.with_sharding_'
+            'constraint / an explicit transpose stage) so the '
+            'collective is visible and tunable')
+
+
+@rule('NBK602', 'mesh-sized shard_map output declared replicated by '
+                'out_specs')
+def replicated_mesh_output(ctx):
+    """``out_specs=P()`` means every device holds the full result: a
+    mesh-sized output is silently all_gathered and then stored P
+    times over.  Legitimate for scalars and reduced values (psum
+    results) — this fires only when the returned value is mesh-sized
+    or flows from a sharded input and is not reduced on the way
+    out."""
+    from .shardflow import find_replicated_outputs
+    for call, idx, spec in find_replicated_outputs(ctx):
+        yield _finding(
+            'NBK602', ctx, call,
+            'shard_map output %d is mesh-sized but out_specs declare '
+            '%s (fully replicated) — the result is all_gathered and '
+            'held once per device' % (idx, spec),
+            'give the output a sharded spec (e.g. P(AXIS)) or reduce '
+            'it inside the body (psum/sum) before returning if a '
+            'replicated scalar is what you actually want')
+
+
+@rule('NBK603', 'shard_map in_specs/out_specs arity does not match '
+                'the wrapped function')
+def spec_arity_mismatch(ctx):
+    """A literal in_specs tuple whose length differs from the wrapped
+    function's parameter count (or out_specs vs the returned tuple)
+    fails at trace time with an opaque pytree-structure error — or
+    worse, zips in the wrong order when specs are passed
+    positionally.  Pure structure check: no lattice facts needed, so
+    it fires even where the spec values are unresolvable."""
+    from .shardflow import find_arity_mismatches
+    for call, kind, nspecs, nactual in find_arity_mismatches(ctx):
+        yield _finding(
+            'NBK603', ctx, call,
+            '%s declares %d spec%s but the wrapped function has %d '
+            '%s' % (kind, nspecs, '' if nspecs == 1 else 's',
+                    nactual,
+                    'parameters' if kind == 'in_specs'
+                    else 'returned elements'),
+            'make the %s tuple match the wrapped function one-to-one '
+            '(a single non-tuple spec only broadcasts over one '
+            'argument)' % kind)
+
+
+@rule('NBK604', 'collective names an axis absent from the enclosing '
+                'shard_map mesh')
+def foreign_axis_collective(ctx):
+    """A psum over axis 'dev' inside a shard_map bound to a pencil
+    mesh (axes 'x','y') raises NameError at trace time — or silently
+    reduces over the wrong group when both meshes are in scope.
+    NBK101 checks lexical axis binding; this is the interprocedural
+    form: the mesh is resolved through the boundary construction
+    (constructor table, Mesh literals, name bindings), so it catches
+    a body defined far from its shard_map call."""
+    from .shardflow import find_foreign_axis_collectives
+    for node, names, mesh_axes in find_foreign_axis_collectives(ctx):
+        yield _finding(
+            'NBK604', ctx, node,
+            'collective names axis %s but the enclosing shard_map '
+            'mesh defines only (%s)'
+            % (', '.join(repr(n) for n in sorted(names)),
+               ', '.join(repr(a) for a in mesh_axes)),
+            'use an axis the mesh defines, or rebuild the boundary '
+            'on the mesh that carries this axis (slab meshes bind '
+            '\'dev\', pencil meshes bind \'x\'/\'y\' — runtime.py)')
+
+
+# ---------------------------------------------------------------------------
+# NBK7xx — interprocedural precision-flow analysis (dtypeflow.py)
+
+
+@rule('NBK701', 'collective result stays bf16/f16 — silent demotion '
+                'on the payload')
+def demoted_collective_result(ctx):
+    """Casting an all_to_all/psum payload to bf16 halves the bytes on
+    the wire — the ROADMAP #5 compressed-collective play — but the
+    contract is bf16-in/f32-out: the *result* must be re-widened
+    before anything accumulates it, or the 8-bit mantissa propagates
+    into P(k).  Fires on a collective whose payload is provably
+    narrow and whose result is consumed raw; an immediate
+    ``.astype(f32)`` on the call satisfies the contract and is
+    silent."""
+    from .dtypeflow import find_demoted_collectives
+    for call, dtype in find_demoted_collectives(ctx):
+        yield _finding(
+            'NBK701', ctx, call,
+            'collective payload is %s and its result is consumed '
+            'without re-widening — the demotion silently propagates '
+            'downstream' % dtype,
+            'chain .astype(jnp.float32) directly onto the collective '
+            '(bf16 on the wire, f32 in the math) so the compression '
+            'spends wire bytes, not accuracy budget')
+
+
+@rule('NBK702', 'accumulation into a bf16/f16 buffer without a '
+                'compensated-sum idiom')
+def uncompensated_narrow_accumulation(ctx):
+    """bf16 carries 8 mantissa bits: past ~256 same-magnitude
+    addends, plain accumulation stops absorbing new mass entirely.
+    Mesh painting sums millions of particle deposits per cell — a
+    narrow accumulator needs the two-sum hi/lo residual split
+    (ops/histogram.py's bf16 path) or an f32 partial.  Fires on
+    ``+=``/loop-carried self-add/``.at[].add`` into a provably-narrow
+    accumulator in a function with no residual-split assignment."""
+    from .dtypeflow import find_uncompensated_accumulations
+    for node, name, dtype in find_uncompensated_accumulations(ctx):
+        yield _finding(
+            'NBK702', ctx, node,
+            'accumulation into %s buffer %r with no compensated-sum '
+            '(hi/lo residual) idiom in this function — additions '
+            'beyond ~2**mantissa same-scale addends are lost'
+            % (dtype, name),
+            'accumulate in f32 and cast once at the end, or split '
+            'each addend hi/lo against the running sum '
+            '(ops/histogram.py two-sum pattern) so dropped residue '
+            'is re-injected')
+
+
+@rule('NBK703', 'mixed-dtype arithmetic promotes a mesh-sized '
+                'operand to the wider dtype')
+def promoting_mixed_arith(ctx):
+    """``bf16_mesh * f32_kernel`` materializes a full-mesh f32 copy
+    of the narrow operand before the op runs — the promotion
+    allocates exactly the bytes the bf16 mesh existed to avoid, and
+    doubles peak at the worst moment.  Fires only when both dtypes
+    are proven and the *narrow* side is mesh-sized; scalar-side
+    promotion is free and stays silent."""
+    from .dtypeflow import find_promoting_mixed_arith
+    for node, narrow, wide in find_promoting_mixed_arith(ctx):
+        yield _finding(
+            'NBK703', ctx, node,
+            'mesh-sized %s operand promoted to %s by mixed-dtype '
+            'arithmetic — a full-mesh %s copy materializes for the '
+            'op' % (narrow, wide, wide),
+            'cast the small/scalar side down to %s, or do this stage '
+            'in %s on a slab-at-a-time chunk so the wide copy never '
+            'spans the mesh' % (narrow, wide))
+
+
+@rule('NBK704', 'int32 flattened-index chain with no safe static '
+                'bound (value-range upgrade of NBK302)')
+def i32_range_overflow(ctx):
+    """NBK302 pattern-matches chained i32 index multiplication;
+    this rule *evaluates* it.  Factor bounds from literals,
+    module/project constants and the declared ``--nmesh`` prove a
+    chain < 2**31 (silent — the upgrade: provably-safe sites need no
+    pragma), prove it overflowing (definite finding), or leave it
+    unbounded — in which case a trace-time ``iinfo(int32)`` raise in
+    the same function (the ops/paint.py guard) counts as the audit
+    and silences it."""
+    from .dtypeflow import find_i32_range_overflow
+    for node, verdict, bound in find_i32_range_overflow(ctx):
+        if verdict == 'overflow':
+            yield _finding(
+                'NBK704', ctx, node,
+                'int32 index chain provably reaches %d (>= 2**31) '
+                'under the declared bounds — guaranteed overflow'
+                % bound,
+                'compute the flattened index in int64 '
+                '(x64-enabled) or split the index into '
+                'per-dimension int32 coordinates')
+        else:
+            yield _finding(
+                'NBK704', ctx, node,
+                'int32 index chain has no derivable static bound '
+                'and the function carries no trace-time '
+                'iinfo(int32) guard',
+                'add a trace-time bound check that raises before '
+                'lowering (ops/paint.py: '
+                'if bound > np.iinfo(np.int32).max: raise), or '
+                'bound the factors with module constants so the '
+                'range is provable')

@@ -1,0 +1,689 @@
+"""NBK6xx — interprocedural sharding-flow analysis.
+
+The failure class this targets arrives with the partition-rule
+ingestion plane (ROADMAP #3): once PartitionSpecs are data — built per
+catalog column by rule trees — a spec disagreement between producer
+and consumer no longer fails loudly.  jax inserts the reshard for you:
+an implicit all_to_all (or worse, an all_gather) hiding inside a jit
+boundary, invisible until a profile shows the FFT's collective budget
+spent twice.  Likewise a mesh-sized output with replicated
+``out_specs`` is a silent P-way all_gather plus P copies of a buffer
+the memory plan priced once.
+
+**The spec model.**  A PartitionSpec is abstracted to a tuple of
+per-dimension entries: an axis name (string), a tuple of axis names,
+``None`` (replicated), or :data:`UNRESOLVED` when the expression
+cannot be pinned statically.  Specs are read from literal ``P(...)`` /
+``PartitionSpec(...)`` calls — through module/project constants
+(``AXIS``), single-assignment local names, and tuple-unpack bindings
+(``in1, out1 = P(...), P(...)``).  Anything dynamic (comprehensions,
+concatenation, parameters) stays :data:`UNRESOLVED` and the rules are
+silent about it: like the rest of nbkl, false negatives are preferred
+to noise.
+
+Spec facts then flow interprocedurally: every ``shard_map``
+construction becomes a :class:`Boundary` (wrapped function, in/out
+specs, mesh axes); calling a boundary binds its ``out_specs`` to the
+result name; function return summaries run to fixpoint over the
+:class:`~nbodykit_tpu.lint.callgraph.Project` graph so a helper that
+returns a sharded field carries its spec to call sites in other
+modules.  Mesh-sizedness is delegated to the NBK5xx value model
+(sizes.py ``_OWN`` taint) — a chunk-sized scalar crossing with a
+different spec is cheap and not flagged.
+
+The mesh itself resolves through the repo's constructor table
+(:data:`MESH_CONSTRUCTOR_AXES` — ``cpu_mesh()``/``tpu_mesh()`` bind
+``('dev',)``, ``pencil_mesh()`` binds ``('x', 'y')``) or a literal
+``Mesh(..., axis_names=...)`` / ``jax.make_mesh`` call.
+
+Rules
+-----
+NBK601  mesh-sized value crossing a shard_map boundary with a spec
+        that disagrees with the spec it was produced under — an
+        implicit reshard (hidden all_to_all/all_gather).
+NBK602  mesh-sized, non-reduced output bound to replicated
+        ``out_specs`` — a hidden P-way all_gather and P replicas.
+NBK603  literal ``in_specs``/``out_specs`` whose arity disagrees with
+        the wrapped function's signature / return tuple.
+NBK604  collective inside a shard_map body naming an axis the
+        resolved mesh does not define.
+
+``--shard-report`` renders every discovered boundary with its
+resolved specs and mesh axes (the sharding analogue of sizes.py's
+``--memory-report``).
+"""
+
+import ast
+import collections
+
+from .scopes import SHARD_MAP_NAMES
+from . import sizes as _sizes
+
+
+class _Unresolved(object):
+    """Singleton spec entry for statically-unresolvable expressions."""
+    __slots__ = ()
+
+    def __repr__(self):
+        return '?'
+
+
+UNRESOLVED = _Unresolved()
+
+#: repo mesh-constructor tails -> the axis names they bind
+#: (parallel/runtime.py: the slab constructors all share AXIS='dev',
+#: pencil_mesh binds (AXIS_X, AXIS_Y) = ('x', 'y'))
+MESH_CONSTRUCTOR_AXES = {
+    'world_mesh': ('dev',),
+    'single_device_mesh': ('dev',),
+    'cpu_mesh': ('dev',),
+    'tpu_mesh': ('dev',),
+    'pencil_mesh': ('x', 'y'),
+}
+
+#: collectives that REDUCE over the mesh axis — a replicated out_spec
+#: on their result is the correct contract, not a hidden gather
+_REDUCING_COLLECTIVES = frozenset({
+    'psum', 'pmean', 'pmax', 'pmin', 'psum_scatter'})
+
+Boundary = collections.namedtuple('Boundary', [
+    'ctx', 'call', 'fn', 'in_specs', 'in_tuple',
+    'out_specs', 'out_tuple', 'mesh_axes'])
+
+
+# ---------------------------------------------------------------------------
+# spec / mesh parsing
+
+
+def _binding(ctx, name, at):
+    """The unique expression assigned to ``name`` in the scope chain
+    of ``at`` (including one tuple-unpack level), or None when the
+    name is unbound, rebound, or bound dynamically."""
+    for scope in ctx.scope_chain(at):
+        hits = []
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            if ctx.enclosing_scope(node) is not scope:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    hits.append(node.value)
+                elif isinstance(t, (ast.Tuple, ast.List)) and \
+                        isinstance(node.value, (ast.Tuple, ast.List)) \
+                        and len(t.elts) == len(node.value.elts):
+                    for te, ve in zip(t.elts, node.value.elts):
+                        if isinstance(te, ast.Name) and te.id == name:
+                            hits.append(ve)
+        if hits:
+            return hits[0] if len(hits) == 1 else None
+    return None
+
+
+def _parse_spec(ctx, call):
+    """A literal ``P(...)``/``PartitionSpec(...)`` call -> entry
+    tuple, or None when the call is not a spec constructor."""
+    if not isinstance(call, ast.Call):
+        return None
+    q = ctx.qual(call.func) or ''
+    if q.rsplit('.', 1)[-1] not in ('P', 'PartitionSpec'):
+        return None
+    out = []
+    for a in call.args:
+        out.append(_spec_entry(ctx, a))
+    return tuple(out)
+
+
+def _spec_entry(ctx, node):
+    if isinstance(node, ast.Constant) and node.value is None:
+        return None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        members = []
+        for e in node.elts:
+            s = ctx.const_str(e)
+            if s is None:
+                return UNRESOLVED
+            members.append(s)
+        return tuple(members)
+    s = ctx.const_str(node)
+    return s if s is not None else UNRESOLVED
+
+
+def _single_spec(ctx, node, at, depth=0):
+    """One spec tuple for an expression, following Name bindings."""
+    if depth > 3 or node is None:
+        return None
+    spec = _parse_spec(ctx, node)
+    if spec is not None:
+        return spec
+    if isinstance(node, ast.Name):
+        b = _binding(ctx, node.id, at)
+        if b is not None:
+            return _single_spec(ctx, b, b, depth + 1)
+    return None
+
+
+def _specs_arg(ctx, node, at):
+    """An ``in_specs``/``out_specs`` keyword value ->
+    ``(list of spec-or-None, is_literal_tuple)``; ``(None, False)``
+    when nothing resolves."""
+    if node is None:
+        return None, False
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [_single_spec(ctx, e, at) for e in node.elts], True
+    spec = _single_spec(ctx, node, at)
+    if spec is not None:
+        return [spec], False
+    if isinstance(node, ast.Name):
+        b = _binding(ctx, node.id, at)
+        if isinstance(b, (ast.Tuple, ast.List)):
+            return [_single_spec(ctx, e, b) for e in b.elts], True
+    return None, False
+
+
+def _axis_strs(ctx, node):
+    """frozenset of axis-name strings, or None when any token fails
+    to resolve."""
+    if node is None:
+        return None
+    toks = ctx.axis_tokens(node)
+    if not toks or any(k != 'str' for k, _ in toks):
+        return None
+    return frozenset(v for _, v in toks)
+
+
+def mesh_axes_of(ctx, node, at, depth=0):
+    """Axis names a ``mesh=`` expression binds, or None: the repo
+    constructor table, literal ``Mesh``/``make_mesh`` calls, and Name
+    bindings thereto.  Parameters / attributes stay unresolved."""
+    if depth > 3 or node is None:
+        return None
+    if isinstance(node, ast.Call):
+        q = ctx.call_name(node) or ''
+        tail = q.rsplit('.', 1)[-1]
+        if tail in MESH_CONSTRUCTOR_AXES:
+            return frozenset(MESH_CONSTRUCTOR_AXES[tail])
+        if tail in ('Mesh', 'make_mesh'):
+            ax = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == 'axis_names':
+                    ax = kw.value
+            return _axis_strs(ctx, ax)
+        return None
+    if isinstance(node, ast.Name):
+        b = _binding(ctx, node.id, at)
+        if b is not None:
+            return mesh_axes_of(ctx, b, b, depth + 1)
+    return None
+
+
+def _wrapped_fn(ctx, call):
+    """The function a shard_map call wraps: a direct Lambda or a Name
+    resolving to a def — anything else (builder calls, attributes)
+    stays None."""
+    if not call.args:
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Lambda):
+        return a
+    if isinstance(a, ast.Name):
+        return ctx._resolve_def(a, call)
+    return None
+
+
+def _boundaries(ctx):
+    """{id(call): Boundary} for every shard_map construction in the
+    module."""
+    out = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not ctx.matches(ctx.call_name(node), SHARD_MAP_NAMES,
+                           {'shard_map'}):
+            continue
+        ins = outs = None
+        in_t = out_t = False
+        mesh = None
+        for kw in node.keywords:
+            if kw.arg == 'in_specs':
+                ins, in_t = _specs_arg(ctx, kw.value, node)
+            elif kw.arg == 'out_specs':
+                outs, out_t = _specs_arg(ctx, kw.value, node)
+            elif kw.arg == 'mesh':
+                mesh = mesh_axes_of(ctx, kw.value, node)
+        out[id(node)] = Boundary(ctx, node, _wrapped_fn(ctx, node),
+                                 ins, in_t, outs, out_t, mesh)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# spec helpers
+
+
+def _resolved(spec):
+    return spec is not None and UNRESOLVED not in spec
+
+
+def _norm(spec):
+    """Strip trailing replicated dims: P('dev') == P('dev', None)."""
+    spec = tuple(spec)
+    while spec and spec[-1] is None:
+        spec = spec[:-1]
+    return spec
+
+
+def _spec_axes(spec):
+    """Axis-name strings a spec shards over."""
+    out = set()
+    for e in spec or ():
+        if isinstance(e, str):
+            out.add(e)
+        elif isinstance(e, tuple):
+            out.update(e)
+    return out
+
+
+def render_spec(spec):
+    if spec is None:
+        return '?'
+    return 'P(%s)' % ','.join(
+        '?' if e is UNRESOLVED
+        else 'None' if e is None
+        else '+'.join(e) if isinstance(e, tuple)
+        else e
+        for e in spec)
+
+
+def _params_of(fn):
+    """Positional parameter names, or None when *args makes the arity
+    open."""
+    a = fn.args
+    if a.vararg is not None:
+        return None
+    return [p.arg for p in a.posonlyargs + a.args if p.arg != 'self']
+
+
+def _return_exprs(ctx, fn):
+    """The function's return expressions (Lambda body counts)."""
+    if isinstance(fn, ast.Lambda):
+        return [fn.body]
+    return [n.value for n in ast.walk(fn)
+            if isinstance(n, ast.Return) and n.value is not None
+            and ctx.enclosing_function(n) is fn]
+
+
+def _return_elements(ctx, fn, nspecs, out_tuple):
+    """Per-out_spec return expressions, or None when the return
+    structure cannot be matched to the specs."""
+    exprs = _return_exprs(ctx, fn)
+    if len(exprs) != 1:
+        return None
+    e = exprs[0]
+    if not out_tuple:
+        return [e] if nspecs == 1 else None
+    if isinstance(e, (ast.Tuple, ast.List)) and len(e.elts) == nspecs:
+        return list(e.elts)
+    return None
+
+
+def _is_reduced(ctx, expr):
+    """Is the expression a reduction — a reducing collective or a
+    REDUCER_TAILS call (possibly re-cast with .astype)?"""
+    e = expr
+    for _ in range(2):
+        if not isinstance(e, ast.Call):
+            return False
+        tail = _sizes._call_tail(ctx, e)
+        if tail in _REDUCING_COLLECTIVES or \
+                tail in _sizes.REDUCER_TAILS:
+            return True
+        if tail == 'astype' and isinstance(e.func, ast.Attribute):
+            e = e.func.value
+            continue
+        return False
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the interprocedural analysis
+
+
+class _Analysis(object):
+    """Project-wide boundary table plus a returns-spec fixpoint."""
+
+    def __init__(self, project):
+        self.project = project
+        self.bounds = {}        # id(call) -> Boundary
+        self.by_ctx = {}        # id(ctx) -> [Boundary]
+        for ctx in project.contexts:
+            bs = _boundaries(ctx)
+            self.bounds.update(bs)
+            self.by_ctx[id(ctx)] = list(bs.values())
+        # (id(scope), name) -> Boundary for `s1 = shard_map(...)` /
+        # `j1 = jit(s1)` wrapper assignments; two passes so a jit of
+        # a later-defined name still resolves
+        self.wrappers = {id(ctx): {} for ctx in project.contexts}
+        for _ in range(2):
+            for ctx in project.contexts:
+                table = self.wrappers[id(ctx)]
+                for node in ast.walk(ctx.tree):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    b = self._construction(ctx, node.value)
+                    if b is None:
+                        continue
+                    scope = ctx.enclosing_scope(node)
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            table[(id(scope), t.id)] = b
+        # returns-spec summaries to fixpoint
+        self.returns_spec = {}
+        for _ in range(4):
+            changed = False
+            for ctx, fn in project.functions():
+                spec = self._fn_return_spec(ctx, fn)
+                if spec != self.returns_spec.get(id(fn)):
+                    self.returns_spec[id(fn)] = spec
+                    changed = True
+            if not changed:
+                break
+
+    # -- boundary resolution -----------------------------------------------
+
+    def _construction(self, ctx, node, depth=0):
+        """Boundary when ``node`` constructs (a wrapper around) a
+        shard_map: ``shard_map(...)``, ``jit(shard_map(...))``,
+        ``instrumented_jit(s1)``."""
+        if depth > 3 or not isinstance(node, ast.Call):
+            return None
+        b = self.bounds.get(id(node))
+        if b is not None:
+            return b
+        q = ctx.call_name(node) or ''
+        tail = q.rsplit('.', 1)[-1]
+        if tail in ('jit', 'pjit', 'pmap', 'instrumented_jit',
+                    'partial', 'checkpoint', 'remat') and node.args:
+            inner = node.args[0]
+            if isinstance(inner, ast.Call):
+                return self._construction(ctx, inner, depth + 1)
+            if isinstance(inner, ast.Name):
+                return self._named(ctx, inner.id, node)
+        return None
+
+    def _named(self, ctx, name, at):
+        table = self.wrappers.get(id(ctx), {})
+        for scope in ctx.scope_chain(at):
+            b = table.get((id(scope), name))
+            if b is not None:
+                return b
+        return None
+
+    def boundary_of_call(self, ctx, call):
+        """The Boundary a call site invokes, or None —
+        ``s1(x)`` through a wrapper name, or the immediate
+        ``jax.shard_map(...)(x)`` form."""
+        f = call.func
+        if isinstance(f, ast.Call):
+            return self._construction(ctx, f)
+        if isinstance(f, ast.Name):
+            return self._named(ctx, f.id, call)
+        return None
+
+    # -- spec dataflow -----------------------------------------------------
+
+    def spec_facts(self, ctx, fn):
+        """{name: spec} for names in ``fn`` bound to results of
+        boundary calls (with resolved single/tuple out_specs) or of
+        functions whose returns-spec summary is known."""
+        facts = {}
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if ctx.enclosing_function(node) is not fn:
+                    continue
+                specs = self._result_specs(ctx, node.value)
+                if not specs:
+                    continue
+                tgt = node.targets[0] if len(node.targets) == 1 \
+                    else None
+                if isinstance(tgt, ast.Name) and len(specs) == 1 and \
+                        specs[0] is not None:
+                    facts[tgt.id] = specs[0]
+                elif isinstance(tgt, (ast.Tuple, ast.List)) and \
+                        len(tgt.elts) == len(specs):
+                    for te, s in zip(tgt.elts, specs):
+                        if isinstance(te, ast.Name) and s is not None:
+                            facts[te.id] = s
+        return facts
+
+    def _result_specs(self, ctx, value):
+        """Out-spec list of a call expression's result, or None."""
+        if not isinstance(value, ast.Call):
+            return None
+        b = self.boundary_of_call(ctx, value)
+        if b is not None and b.out_specs:
+            return b.out_specs
+        tgt = self.project.resolve_call(ctx, value)
+        if tgt is not None and tgt.ref is not None:
+            spec = self.returns_spec.get(id(tgt.ref.node))
+            if spec is not None:
+                return [spec]
+        return None
+
+    def _fn_return_spec(self, ctx, fn):
+        """Spec of the function's (single) return value, or None."""
+        exprs = _return_exprs(ctx, fn)
+        if len(exprs) != 1:
+            return None
+        e = exprs[0]
+        if isinstance(e, ast.Name):
+            return self.spec_facts(ctx, fn).get(e.id)
+        specs = self._result_specs(ctx, e)
+        if specs and len(specs) == 1:
+            return specs[0]
+        return None
+
+
+def analysis_for(project):
+    cached = getattr(project, '_shard_analysis', None)
+    if cached is None:
+        cached = _Analysis(project)
+        project._shard_analysis = cached
+    return cached
+
+
+def _project_of(ctx):
+    project = getattr(ctx, 'project', None)
+    if project is None:
+        from .callgraph import single_project
+        project = single_project(ctx)
+    return project
+
+
+# ---------------------------------------------------------------------------
+# rule entry points (wrapped into Findings by rules.py)
+
+
+def find_reshards(ctx):
+    """NBK601 raw findings: (call, name, spec_have, spec_want)."""
+    project = _project_of(ctx)
+    an = analysis_for(project)
+    mem = _sizes.analysis_for(project)
+    out = []
+    for fn in ctx.functions:
+        facts = an.spec_facts(ctx, fn)
+        if not facts:
+            continue
+        fm = mem.func_mem(fn)
+        for call in project.calls_in(ctx, fn):
+            b = an.boundary_of_call(ctx, call)
+            if b is None or not b.in_specs:
+                continue
+            for i, arg in enumerate(call.args):
+                if not isinstance(arg, ast.Name):
+                    continue
+                have = facts.get(arg.id)
+                if have is None or not _resolved(have):
+                    continue
+                want = None
+                if b.in_tuple and i < len(b.in_specs):
+                    want = b.in_specs[i]
+                elif not b.in_tuple and len(call.args) == 1:
+                    want = b.in_specs[0]
+                if want is None or not _resolved(want):
+                    continue
+                if _norm(have) == _norm(want):
+                    continue
+                if fm is None or \
+                        _sizes._OWN not in fm.expr_labels(arg):
+                    continue        # only mesh-sized crossings matter
+                out.append((call, arg.id, have, want))
+    return out
+
+
+def find_replicated_outputs(ctx):
+    """NBK602 raw findings: (call, out_index, label) — mesh-sized,
+    non-reduced outputs bound to fully-replicated out_specs."""
+    project = _project_of(ctx)
+    an = analysis_for(project)
+    mem = _sizes.analysis_for(project)
+    out = []
+    for b in an.by_ctx.get(id(ctx), []):
+        if b.fn is None or not b.out_specs:
+            continue
+        fm = mem.func_mem(b.fn)
+        if fm is None:
+            continue
+        params = _params_of(b.fn)
+        sharded_params = set()
+        if b.in_specs and params is not None:
+            ins = b.in_specs
+            if not b.in_tuple and len(ins) == 1 and len(params) > 1:
+                ins = ins * len(params)
+            for p, s in zip(params, ins):
+                if s is not None and _spec_axes(s):
+                    sharded_params.add(p)
+        rets = _return_elements(ctx, b.fn, len(b.out_specs),
+                                b.out_tuple)
+        if rets is None:
+            continue
+        for idx, (spec, rexpr) in enumerate(zip(b.out_specs, rets)):
+            if not _resolved(spec) or _spec_axes(spec):
+                continue        # unresolved, or sharded somewhere
+            if _is_reduced(ctx, rexpr):
+                continue        # psum/sum output: replication is real
+            labels = fm.expr_labels(rexpr)
+            if _sizes._OWN in labels or (labels & sharded_params):
+                out.append((b.call, idx, render_spec(spec)))
+    return out
+
+
+def find_arity_mismatches(ctx):
+    """NBK603 raw findings: (call, kind, nspecs, nactual)."""
+    project = _project_of(ctx)
+    an = analysis_for(project)
+    out = []
+    for b in an.by_ctx.get(id(ctx), []):
+        if b.fn is None:
+            continue
+        params = _params_of(b.fn)
+        if b.in_tuple and b.in_specs is not None and \
+                params is not None and len(b.in_specs) != len(params):
+            out.append((b.call, 'in_specs', len(b.in_specs),
+                        len(params)))
+        if b.out_tuple and b.out_specs is not None:
+            exprs = _return_exprs(ctx, b.fn)
+            if len(exprs) == 1 and \
+                    isinstance(exprs[0], (ast.Tuple, ast.List)) and \
+                    len(exprs[0].elts) != len(b.out_specs):
+                out.append((b.call, 'out_specs', len(b.out_specs),
+                            len(exprs[0].elts)))
+    return out
+
+
+def find_foreign_axis_collectives(ctx):
+    """NBK604 raw findings: (collective call, axis names, mesh axes)
+    — a collective naming an axis the resolved mesh does not
+    define."""
+    project = _project_of(ctx)
+    an = analysis_for(project)
+    seen = set()
+    out = []
+    for b in an.by_ctx.get(id(ctx), []):
+        if b.mesh_axes is None or b.fn is None:
+            continue
+        for node in ast.walk(b.fn):
+            if not ctx.is_collective(node) or id(node) in seen:
+                continue
+            axis = ctx.collective_axis_arg(node)
+            names = _axis_strs(ctx, axis)
+            if not names or names & b.mesh_axes:
+                continue
+            seen.add(id(node))
+            out.append((node, names, b.mesh_axes))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the shard report
+
+
+def shard_report(project):
+    """Rows for the ``--shard-report`` table: every shard_map
+    boundary with its resolved wrapped function, mesh axes and
+    specs."""
+    an = analysis_for(project)
+    rows = []
+    for ctx in project.contexts:
+        for b in an.by_ctx.get(id(ctx), []):
+            if b.fn is None:
+                label = '?'
+            elif isinstance(b.fn, ast.Lambda):
+                label = '<lambda:%d>' % b.fn.lineno
+            else:
+                label = b.fn.name
+            rows.append({
+                'path': getattr(ctx, 'canonical', ctx.path),
+                'line': b.call.lineno,
+                'function': label,
+                'mesh_axes': sorted(b.mesh_axes)
+                if b.mesh_axes is not None else None,
+                'in_specs': [render_spec(s) for s in b.in_specs]
+                if b.in_specs is not None else None,
+                'out_specs': [render_spec(s) for s in b.out_specs]
+                if b.out_specs is not None else None,
+            })
+    rows.sort(key=lambda r: (r['path'], r['line']))
+    return {'rows': rows}
+
+
+def render_shard_report(report):
+    """The report as aligned text."""
+    rows = report['rows']
+    out = ['== nbkl shard report: %d shard_map boundar%s =='
+           % (len(rows), 'y' if len(rows) == 1 else 'ies')]
+    if not rows:
+        out.append('no shard_map boundaries found')
+        return '\n'.join(out) + '\n'
+
+    def specs(v):
+        return '?' if v is None else '(%s)' % ', '.join(v)
+
+    fw = max(len('%s:%d' % (r['path'], r['line'])) for r in rows)
+    gw = max(len(r['function']) for r in rows)
+    for r in rows:
+        mesh = ','.join(r['mesh_axes']) \
+            if r['mesh_axes'] is not None else '?'
+        out.append('  %-*s  %-*s  mesh=%-5s  in=%s -> out=%s'
+                   % (fw, '%s:%d' % (r['path'], r['line']),
+                      gw, r['function'], mesh,
+                      specs(r['in_specs']), specs(r['out_specs'])))
+    unresolved = sum(1 for r in rows
+                     if r['in_specs'] is None or
+                     r['out_specs'] is None or r['mesh_axes'] is None)
+    out.append('%d boundar%s, %d with unresolved specs/mesh '
+               '(silent for the NBK6xx rules)'
+               % (len(rows), 'y' if len(rows) == 1 else 'ies',
+                  unresolved))
+    return '\n'.join(out) + '\n'

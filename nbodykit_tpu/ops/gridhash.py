@@ -100,9 +100,10 @@ class GridHash(object):
             clipped = jnp.clip(nc, 0, self.ncell - 1)
             oob = jnp.any(nc != clipped, axis=-1)
             nc = clipped
-        # i32-audited (nbkl NBK302): flat ids < prod(ncell) <=
-        # max_ncell^3 = 128^3 ~ 2e6, far inside int32; the uncapped
-        # sibling (devicehash.py) switches to i64 past 2**31 instead
+        # i32-audited: flat ids < prod(ncell) <= max_ncell^3 =
+        # 128^3 ~ 2e6, far inside int32; the uncapped sibling
+        # (devicehash.py) switches to i64 past 2**31 instead
+        # nbkl: disable=NBK704
         nflat = (nc[:, 0] * self.ncell[1] + nc[:, 1]) \
             * self.ncell[2] + nc[:, 2]
         return self.start[nflat], self.count[nflat], oob

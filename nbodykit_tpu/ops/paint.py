@@ -52,6 +52,14 @@ def _offset_terms(pos, mass, resampler, period, origin, n0l):
     static window offset (i, j, k) in s^3 — all 1-D over particles."""
     s = window_support(resampler)
     N1, N2 = period[1], period[2]
+    # trace-time overflow guard: lin below peaks at n0l*N1*N2 - 1 and
+    # is int32 (window indices are i32) — a single-device 1291^3+
+    # block would wrap silently without this (nbkl NBK704)
+    if n0l * N1 * N2 - 1 > np.iinfo(np.int32).max:
+        raise ValueError(
+            'local block (%d, %d, %d) overflows int32 flat indexing; '
+            'shard the mesh over more devices or reduce nmesh'
+            % (n0l, N1, N2))
     i0, w0 = _axis_terms(pos[:, 0], resampler, period[0])
     i1, w1 = _axis_terms(pos[:, 1], resampler, period[1])
     i2, w2 = _axis_terms(pos[:, 2], resampler, period[2])
@@ -747,6 +755,9 @@ def paint_local_mxu(pos, mass, shape, resampler='cic', period=None,
         zm = jnp.zeros((KX, N2), dtype)
         for a in range(s):
             for b in range(s):
+                # tile-local: rloc < rb, |yloc| < N1, so col <
+                # (rb+s)*cbh + N1 — orders of magnitude inside int32
+                # for any tile geometry  # nbkl: disable=NBK704
                 col = (rloc + a) * cbh + (yloc + b)
                 w = (ww0[:, a] * ww1[:, b]).astype(dtype) * smass
                 w0y = w0y + jnp.where(col[:, None] == col_i,

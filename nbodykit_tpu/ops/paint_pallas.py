@@ -70,6 +70,8 @@ def _deposit_kernel(tx_ref, x_ref, y_ref, z_ref, m_ref, o_ref, *,
         w0a = bspline(jnp.abs(x - (b0 + a).astype(x.dtype)), s)
         for b in range(s):
             w1b = bspline(jnp.abs(y - (b1 + b).astype(y.dtype)), s)
+            # tile-local: rloc < rb, |yloc| < N1, so col stays far
+            # inside int32 for any tile  # nbkl: disable=NBK704
             col = (rloc + a) * cbh + (yloc + b)
             w = (w0a * w1b).astype(dtype) * m
             w0y = w0y + jnp.where(col[:, None] == col_i, w[:, None], 0)

@@ -71,6 +71,9 @@ def _binned_power(pm, c, resampler, npart):
     ix, iy, iz = pm.i_list_complex()
     isq = ix * ix + iy * iy + iz * iz
     r = jnp.sqrt(isq.astype(jnp.float32)).astype(jnp.int32)
+    # (r+1)^2 <= 3*(nmesh/2+1)^2, inside int32 for any admissible
+    # mesh (admission caps nmesh well below 5e4)
+    # nbkl: disable=NBK704
     r = r - (r * r > isq) + ((r + 1) * (r + 1) <= isq)
     shell = jnp.minimum(r, nbins - 1)
     wgt = jnp.broadcast_to(pm.hermitian_weights(jnp.float32), p3.shape)
@@ -144,6 +147,8 @@ def _build_single(request, pm):
                   for i, n in enumerate(int(v) for v in pm.Nmesh)]
             dsq = ax[0] ** 2 + ax[1] ** 2 + ax[2] ** 2
             r = jnp.sqrt(dsq.astype(jnp.float32)).astype(jnp.int32)
+            # (r+1)^2 <= 3*(nmesh/2+1)^2, inside int32 for any
+            # admissible mesh  # nbkl: disable=NBK704
             r = r - (r * r > dsq) + ((r + 1) * (r + 1) <= dsq)
             shell = jnp.minimum(r, nbins - 1)
             flat = jnp.broadcast_to(shell, xi3.shape).reshape(-1)

@@ -24,6 +24,11 @@ class SubVolumesCatalog(ArrayCatalog):
         if domain is None:
             domain = [1, 1, 1]
         domain = np.asarray(domain, dtype='i8')
+        # flat ids below are int32 on-device; guard at trace time
+        # before a huge grid wraps silently (nbkl NBK704)
+        if int(np.prod(domain)) - 1 > np.iinfo(np.int32).max:
+            raise ValueError('subvolume grid %s overflows int32 flat '
+                             'indexing' % (tuple(domain),))
         box = np.ones(3) * np.asarray(source.attrs['BoxSize'])
         pos = jnp.asarray(source[position])
         cell = box / domain

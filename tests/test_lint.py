@@ -479,7 +479,9 @@ def test_cli_json_and_rule_catalog(tmp_path):
     assert proc.returncode == 0
     for code in ('NBK101', 'NBK102', 'NBK103', 'NBK201', 'NBK202',
                  'NBK203', 'NBK301', 'NBK302', 'NBK401', 'NBK402',
-                 'NBK501', 'NBK502', 'NBK503'):
+                 'NBK501', 'NBK502', 'NBK503',
+                 'NBK601', 'NBK602', 'NBK603', 'NBK604',
+                 'NBK701', 'NBK702', 'NBK703', 'NBK704'):
         assert code in proc.stdout
 
 

@@ -609,3 +609,36 @@ def test_doctor_cross_links_watermark_to_nbk5(tmp_path, capsys):
         assert '9.50 GB' in out
     finally:
         REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# regression: the pre-fix eager _fftn_c2c_single_chunked shape
+
+
+def test_nbk503_would_have_caught_eager_chunked_fft():
+    # dfft.py's _fftn_c2c_single_chunked originally allocated the FULL
+    # complex result up front and fori_loop-wrote chunks into it —
+    # peak = input + eager output + per-chunk FFT temporaries, a
+    # multi-GB regression the 2-buffer rewrite removed.  This fixture
+    # freezes that shape: the static peak model must flag it at the
+    # documented 1024^3 complex config, and the same code must stay
+    # silent where it genuinely fits (512^3).
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def fftn_c2c_eager(v, shape_complex):
+        x = to_complex_field(v)
+        out = jnp.zeros(shape_complex, jnp.complex64)
+        def body(i, acc):
+            return acc.at[i].set(jnp.fft.fftn(x[i]))
+        out = jax.lax.fori_loop(0, 8, body, out)
+        return out
+    """
+    config = lint.make_config(1024, dtype_bytes=8, hbm_bytes=16e9)
+    fs = lint_str(src, select=['NBK4', 'NBK5'], memory_config=config)
+    assert 'NBK503' in codes(fs)
+    assert 'full-mesh units at peak' in fs[0].message
+    small = lint.make_config(512, dtype_bytes=8, hbm_bytes=16e9)
+    assert lint_str(src, select=['NBK4', 'NBK5'],
+                    memory_config=small) == []

@@ -1,0 +1,360 @@
+"""NBK6xx — the interprocedural sharding-flow analysis: positive and
+negative fixtures for every rule (NBK601-604), the --shard-report CLI
+surface, and the whole-tree regression pinning the committed baseline
+to zero unexplained NBK6xx entries.
+
+Pure-host AST tests except the CLI subprocess checks.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from nbodykit_tpu import lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_str(src, select=None):
+    return lint.lint_source(
+        'fixture.py', textwrap.dedent(src),
+        project_constants={'AXIS': 'dev'}, select=select)
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# NBK601 — implicit reshard at a shard_map boundary
+
+
+def test_nbk601_spec_disagreement_positive():
+    # produced under P('dev', None) by one boundary, consumed by a
+    # second boundary declaring P(None, 'dev'): jax inserts the
+    # all_to_all silently — NBK601 must not
+    fs = lint_str("""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+    from nbodykit_tpu.ops.paint import paint
+
+    def body_a(pos):
+        return paint(pos)
+
+    def body_b(field):
+        return field * 2
+
+    apply_a = shard_map(body_a, mesh=cpu_mesh(),
+                        in_specs=(P('dev'),), out_specs=P('dev', None))
+    apply_b = shard_map(body_b, mesh=cpu_mesh(),
+                        in_specs=(P(None, 'dev'),),
+                        out_specs=P(None, 'dev'))
+
+    def run(pos):
+        y = apply_a(pos)
+        return apply_b(y)
+    """, select=['NBK601'])
+    assert codes(fs) == ['NBK601']
+    assert "P(dev)" in fs[0].message or "P(dev,None)" in fs[0].message
+    assert "P(None,dev)" in fs[0].message
+
+
+def test_nbk601_matching_specs_negative():
+    # same plumbing, consumer declares the producer's spec (modulo
+    # trailing-None normalization) — clean
+    fs = lint_str("""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+    from nbodykit_tpu.ops.paint import paint
+
+    def body_a(pos):
+        return paint(pos)
+
+    def body_b(field):
+        return field * 2
+
+    apply_a = shard_map(body_a, mesh=cpu_mesh(),
+                        in_specs=(P('dev'),), out_specs=P('dev', None))
+    apply_b = shard_map(body_b, mesh=cpu_mesh(),
+                        in_specs=(P('dev'),), out_specs=P('dev'))
+
+    def run(pos):
+        y = apply_a(pos)
+        return apply_b(y)
+    """, select=['NBK601'])
+    assert codes(fs) == []
+
+
+def test_nbk601_chunk_sized_crossing_negative():
+    # spec disagreement on a value the size model cannot prove
+    # mesh-sized: a cheap crossing, stays silent
+    fs = lint_str("""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body_a(x):
+        return x + 1
+
+    def body_b(x):
+        return x * 2
+
+    apply_a = shard_map(body_a, mesh=cpu_mesh(),
+                        in_specs=(P('dev'),), out_specs=P('dev', None))
+    apply_b = shard_map(body_b, mesh=cpu_mesh(),
+                        in_specs=(P(None, 'dev'),),
+                        out_specs=P(None, 'dev'))
+
+    def run(x):
+        y = apply_a(x)
+        return apply_b(y)
+    """, select=['NBK601'])
+    assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# NBK602 — mesh-sized output bound to replicated out_specs
+
+
+def test_nbk602_replicated_output_positive():
+    fs = lint_str("""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body(field):
+        return field * 2
+
+    g = shard_map(body, mesh=cpu_mesh(),
+                  in_specs=(P('dev'),), out_specs=P(None, None))
+
+    def run(field):
+        return g(field)
+    """, select=['NBK602'])
+    assert codes(fs) == ['NBK602']
+    assert 'P(None,None)' in fs[0].message
+
+
+def test_nbk602_reduced_output_negative():
+    # the psum-reduced return REALLY is replicated — that contract is
+    # correct and must stay silent
+    fs = lint_str("""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body(field):
+        return jax.lax.psum(field, 'dev')
+
+    g = shard_map(body, mesh=cpu_mesh(),
+                  in_specs=(P('dev'),), out_specs=P(None, None))
+
+    def run(field):
+        return g(field)
+    """, select=['NBK602'])
+    assert codes(fs) == []
+
+
+def test_nbk602_sharded_output_negative():
+    fs = lint_str("""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body(field):
+        return field * 2
+
+    g = shard_map(body, mesh=cpu_mesh(),
+                  in_specs=(P('dev'),), out_specs=P('dev', None))
+
+    def run(field):
+        return g(field)
+    """, select=['NBK602'])
+    assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# NBK603 — in_specs/out_specs arity mismatch
+
+
+def test_nbk603_in_specs_arity_positive():
+    fs = lint_str("""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body(x):
+        return x + 1
+
+    g = shard_map(body, mesh=cpu_mesh(),
+                  in_specs=(P('dev'), P('dev')), out_specs=P('dev'))
+    """, select=['NBK603'])
+    assert codes(fs) == ['NBK603']
+    assert 'in_specs' in fs[0].message
+
+
+def test_nbk603_out_specs_arity_positive():
+    fs = lint_str("""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body(x):
+        return (x, x, x)
+
+    g = shard_map(body, mesh=cpu_mesh(),
+                  in_specs=(P('dev'),),
+                  out_specs=(P('dev'), P('dev')))
+    """, select=['NBK603'])
+    assert codes(fs) == ['NBK603']
+    assert 'out_specs' in fs[0].message
+
+
+def test_nbk603_matching_arity_negative():
+    fs = lint_str("""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body(x, y):
+        return (x + y, x - y)
+
+    g = shard_map(body, mesh=cpu_mesh(),
+                  in_specs=(P('dev'), P('dev')),
+                  out_specs=(P('dev'), P('dev')))
+    """, select=['NBK603'])
+    assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# NBK604 — collective naming an axis the mesh does not define
+
+
+def test_nbk604_foreign_axis_positive():
+    fs = lint_str("""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body(x):
+        return jax.lax.psum(x, 'rows')
+
+    g = shard_map(body, mesh=cpu_mesh(),
+                  in_specs=(P('dev'),), out_specs=P(None,))
+    """, select=['NBK604'])
+    assert codes(fs) == ['NBK604']
+    assert 'rows' in fs[0].message
+    assert 'dev' in fs[0].message
+
+
+def test_nbk604_pencil_axes_negative():
+    # the pencil mesh defines BOTH 'x' and 'y' — collectives over
+    # either are legal
+    fs = lint_str("""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import pencil_mesh
+
+    def body(v):
+        v = jax.lax.psum(v, 'x')
+        return jax.lax.psum(v, 'y')
+
+    g = shard_map(body, mesh=pencil_mesh(),
+                  in_specs=(P('x', 'y'),), out_specs=P(None,))
+    """, select=['NBK604'])
+    assert codes(fs) == []
+
+
+def test_nbk604_unresolved_mesh_negative():
+    # mesh arrives as a parameter: axes unknown, the rule must stay
+    # silent rather than guess
+    fs = lint_str("""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    def body(x):
+        return jax.lax.psum(x, 'rows')
+
+    def build(mesh):
+        return shard_map(body, mesh=mesh,
+                         in_specs=(P('dev'),), out_specs=P(None,))
+    """, select=['NBK604'])
+    assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# the --shard-report surface
+
+
+def test_shard_report_lists_boundaries():
+    from nbodykit_tpu.lint import callgraph, shardflow
+    from nbodykit_tpu.lint.scopes import ModuleContext
+
+    src = textwrap.dedent("""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from nbodykit_tpu.parallel.runtime import cpu_mesh
+
+    def body(x):
+        return x + 1
+
+    g = shard_map(body, mesh=cpu_mesh(),
+                  in_specs=(P('dev'),), out_specs=P('dev'))
+    """)
+    ctx = ModuleContext('fixture.py', src,
+                        project_constants={'AXIS': 'dev'})
+    project = callgraph.single_project(ctx)
+    report = shardflow.shard_report(project)
+    assert len(report['rows']) == 1
+    row = report['rows'][0]
+    assert row['function'] == 'body'
+    assert row['in_specs'] == ['P(dev)']
+    assert row['out_specs'] == ['P(dev)']
+    assert row['mesh_axes'] == ['dev']
+    text = shardflow.render_shard_report(report)
+    assert 'body' in text and 'P(dev)' in text
+
+
+def test_shard_report_cli():
+    out = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', '--shard-report',
+         os.path.join(REPO, 'nbodykit_tpu', 'parallel', 'dfft.py'),
+         os.path.join(REPO, 'nbodykit_tpu', 'parallel', 'runtime.py')],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert 'shard_map boundaries' in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# whole-tree regression
+
+
+def test_tree_has_no_unexplained_nbk6_findings():
+    # every NBK6xx finding in the repo was triaged in-PR: fixed or
+    # pragma'd with an audit comment.  The committed baseline must
+    # carry ZERO grandfathered NBK6xx entries, and a fresh tree run
+    # must come back clean.
+    with open(os.path.join(REPO, 'lint_baseline.json')) as f:
+        baseline = json.load(f)
+    assert not [e for e in baseline.get('findings', [])
+                if e['code'].startswith('NBK6')]
+    out = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', '--select', 'NBK6',
+         os.path.join(REPO, 'nbodykit_tpu'),
+         os.path.join(REPO, 'bench.py')],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
