@@ -349,7 +349,8 @@ def _timed_reps(once, reps, label, ckpt=None, key=None, rec=None,
     ``once`` must run AND sync one rep.  Returns the mean rep wall.
     """
     from nbodykit_tpu.diagnostics import span
-    from nbodykit_tpu.resilience import Supervisor, fault_point
+    from nbodykit_tpu.resilience import (Supervisor, check_preemption,
+                                         fault_point)
     sup = Supervisor('bench.%s' % label, ladder=ladder, checkpoint=ckpt)
     done, elapsed = 0, 0.0
     if ckpt is not None and key is not None:
@@ -362,16 +363,32 @@ def _timed_reps(once, reps, label, ckpt=None, key=None, rec=None,
             if rec is not None:
                 rec['resumed'] = True
                 rec['resumed_reps'] = done
-    for r in range(done, reps):
-        fault_point('bench.rep')
-        t0 = time.time()
-        with span('bench.rep', label=label, rep=r):
-            sup.run(once)
-        elapsed += time.time() - t0
-        if key is not None:
-            sup.save(key, {'label': label, 'reps': reps,
-                           'completed': r + 1,
-                           'elapsed_s': round(elapsed, 6)})
+    completed = done
+    try:
+        for r in range(done, reps):
+            fault_point('bench.rep')
+            # the rep boundary is the safe point: every completed rep is
+            # already checkpointed, so a SIGTERM'd run stops HERE (zero
+            # recomputed reps on relaunch) instead of starting rep r
+            check_preemption('bench.%s.rep%d' % (label, r))
+            t0 = time.time()
+            with span('bench.rep', label=label, rep=r):
+                sup.run(once)
+            elapsed += time.time() - t0
+            completed = r + 1
+            if key is not None:
+                sup.save(key, {'label': label, 'reps': reps,
+                               'completed': completed,
+                               'elapsed_s': round(elapsed, 6)})
+    except Exception:
+        from nbodykit_tpu.resilience import preemption_requested
+        if preemption_requested() and rec is not None:
+            # the per-rep checkpoint above is the sealed state; the
+            # staged record marks the rung interrupted-but-resumable
+            rec['preempted'] = True
+            _stage_partial(rec, partial=True, stage='preempted',
+                           completed_reps=completed)
+        raise
     if rec is not None and sup.events:
         retr = [e for e in sup.events if e['kind'] == 'retries']
         degr = [e for e in sup.events if e['kind'] == 'degradations']
@@ -498,6 +515,7 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
     # here instead of restarting the rung
     from nbodykit_tpu.resilience import CheckpointStore, default_ladder
     ckpt = CheckpointStore(CKPT_DIR)
+    ckpt.gc_tmp()   # sweep stale .tmp debris from earlier killed runs
     ckey = 'bench.' + rec['metric']
     # the axon remote-compile helper dies on the fused program at
     # Nmesh>=512 (HTTP 500 / subprocess exit 1, and the dead helper
@@ -1266,6 +1284,7 @@ def _best_cached_tpu():
 
 
 def cmd_worker():
+    from nbodykit_tpu.resilience import Preempted
     detail = {"state": "starting", "t0": time.time(), "probe": None,
               "paint": [], "configs": [], "done": False}
     _flush_detail(detail)
@@ -1373,6 +1392,17 @@ def cmd_worker():
             _cache_tpu_result(res)
             _cache_cpu_baseline(res)
             note("ok: %s" % res)
+        except Preempted:
+            # SIGTERM'd mid-ladder: the rung's per-rep checkpoint is
+            # already sealed — record the interruption and get out
+            # inside the grace budget (relaunch resumes this rung)
+            detail['state'] = 'preempted'
+            detail['preempted'] = True
+            detail['done'] = False
+            _flush_detail(detail)
+            note("preempted at Nmesh=%d Npart=%d — exiting within "
+                 "grace budget" % (Nmesh, Npart))
+            raise
         except Exception as e:
             detail['configs'].append({
                 "metric": "fftpower_nmesh%d_npart%.0e" % (Nmesh, Npart),
@@ -1620,16 +1650,30 @@ if __name__ == '__main__':
     argv = _parse_fft_flags(sys.argv[1:])
     if not argv:
         sys.exit(main())
+    # SIGTERM (preemption notice) gets a grace budget to finish the
+    # current rep, checkpoint, and exit PREEMPTED_EXIT — the relaunch
+    # resumes with zero recomputed reps (nbodykit_tpu.resilience.fleet)
+    from nbodykit_tpu.resilience import (PREEMPTED_EXIT, Preempted,
+                                         install_preemption_handler)
+    install_preemption_handler(grace_s=float(
+        os.environ.get('BENCH_PREEMPT_GRACE_S', '30') or 30))
     if argv[0] == '--worker':
-        sys.exit(cmd_worker())
+        try:
+            sys.exit(cmd_worker())
+        except Preempted:
+            sys.exit(PREEMPTED_EXIT)
     if argv[0] == '--config':
         # BENCH_REPS / BENCH_PHASES: the fault-injected resume smoke
         # (scripts/smoke.sh, tests/test_resilience.py) runs a tiny
         # 2-rep config with the phase split off
-        print(json.dumps(run_config(
-            int(argv[1]), int(argv[2]), *(argv[3:4] or ['scatter']),
-            reps=int(os.environ.get('BENCH_REPS', '2') or 2),
-            phases=os.environ.get('BENCH_PHASES', '1') != '0')))
+        try:
+            print(json.dumps(run_config(
+                int(argv[1]), int(argv[2]), *(argv[3:4] or ['scatter']),
+                reps=int(os.environ.get('BENCH_REPS', '2') or 2),
+                phases=os.environ.get('BENCH_PHASES', '1') != '0')))
+        except Preempted as e:
+            print(json.dumps({'preempted': True, 'detail': str(e)}))
+            sys.exit(PREEMPTED_EXIT)
         sys.exit(0)
     if argv[0] == '--fftbw':
         print(json.dumps(run_fftbw(int(argv[1]) if argv[1:] else 512)))

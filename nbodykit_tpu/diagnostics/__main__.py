@@ -262,7 +262,10 @@ def _resilience_counts(trace):
               if m.get('type') == 'counter'}
     span_keys = {'resilience.retry': 'retries',
                  'resilience.degrade': 'degradations',
-                 'resilience.resume': 'resumes'}
+                 'resilience.resume': 'resumes',
+                 'resilience.preempted': 'preempted',
+                 'resilience.fleet.dead_rank': 'fleet.dead_ranks',
+                 'resilience.fleet.reform': 'fleet.reformed'}
     if trace and os.path.exists(trace):
         try:
             from .analyze import load_processes
@@ -521,6 +524,56 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                          'runs' % res['resumed_records'])
             lines.append('resilience   OK: %s; no pending '
                          'checkpoints%s' % (activity, extra))
+
+        # fleet posture: preemptions, dead ranks, shrink-to-survive
+        # re-formations, and the coordinated-checkpoint directory's
+        # sealed/incomplete ledger (nbodykit_tpu.resilience.fleet)
+        from .regress import fleet_summary
+        flt = fleet_summary(root) if root is not None else {}
+        preempted = max(counts.get('preempted', 0),
+                        flt.get('preempted_records', 0))
+        dead = counts.get('fleet.dead_ranks', 0)
+        reforms = flt.get('reformations') or []
+        incomplete = flt.get('incomplete_seqs', 0)
+        orphans = flt.get('orphan_tmp', 0)
+        activity = ('preemptions=%d dead_ranks=%d sealed=%d'
+                    % (preempted, dead,
+                       flt.get('sealed_manifests',
+                               counts.get('fleet.manifests_sealed',
+                                          0))))
+        problems = []
+        if incomplete:
+            problems.append('%d INCOMPLETE manifest seq(s) — a seal '
+                            'died mid-commit, the previous sealed '
+                            'manifest stays authoritative; a relaunch '
+                            'or fleet gc clears the debris'
+                            % incomplete)
+        if preempted:
+            problems.append('%d preemption(s) took the grace-budget '
+                            'exit — relaunch resumes from the sealed '
+                            'checkpoint' % preempted)
+        if dead:
+            problems.append('%d dead rank(s) detected by the live '
+                            'monitor' % dead)
+        if orphans:
+            problems.append('%d orphaned .tmp file(s) (gc candidates)'
+                            % orphans)
+        notes = ''
+        if reforms:
+            notes = '; ' + '; '.join(
+                '%s resumed with a SHRUNK mesh (%s -> %s ranks)'
+                % (rf.get('metric', '?'), rf.get('reformed_from', '?'),
+                   rf.get('reformed_to', '?')) for rf in reforms)
+        if problems:
+            warn.append('fleet')
+            lines.append('fleet        WARN: %s; %s%s'
+                         % (activity, '; '.join(problems), notes))
+        elif preempted or dead or reforms \
+                or flt.get('sealed_manifests'):
+            lines.append('fleet        OK: %s%s' % (activity, notes))
+        else:
+            lines.append('fleet        OK: no preemptions, dead '
+                         'ranks, or fleet checkpoints this round')
 
     if root is not None:
         # serving posture: the latest committed servetrace round.  The

@@ -310,13 +310,21 @@ def heartbeat_report(procs, offsets):
     """Per-process liveness from the ``hb`` records: when was each
     process last heard from (any record), and did it fall silent before
     the trace ended (gap > 3 heartbeat intervals)?  Processes traced
-    without a heartbeat get ``silent: None`` (no liveness claim)."""
-    last_seen, hb = {}, {}
+    without a heartbeat get ``silent: None`` (no liveness claim).  A
+    process whose trace carries a ``resilience.preempted`` event
+    announced a *clean* preemption exit — it is reported ``preempted``,
+    never ``silent``, so a SIGTERM'd worker stops reading as a
+    killed-or-wedged one."""
+    last_seen, hb, preempted = {}, {}, {}
     for p, records in procs.items():
         off = offsets.get(p, 0.0)
         last = None
         iv, count = None, 0
+        pre = False
         for r in records:
+            if r.get('t') == 'span' and \
+                    r.get('name') == 'resilience.preempted':
+                pre = True
             ts = r.get('ts')
             if ts is None:
                 continue
@@ -329,6 +337,7 @@ def heartbeat_report(procs, offsets):
                 iv = float(r['heartbeat_s'])
         last_seen[p] = last
         hb[p] = (iv, count)
+        preempted[p] = pre
     end = max((t for t in last_seen.values() if t is not None),
               default=None)
     out = {}
@@ -337,11 +346,13 @@ def heartbeat_report(procs, offsets):
         gap = None if (end is None or last_seen[p] is None) \
             else round(end - last_seen[p], 6)
         silent = None
-        if iv and gap is not None:
+        if preempted[p]:
+            silent = False
+        elif iv and gap is not None:
             silent = gap > max(3.0 * iv, 2.0)
         out[str(p)] = {'last_seen': last_seen[p], 'gap_s': gap,
                        'hb_count': count, 'hb_interval_s': iv,
-                       'silent': silent}
+                       'preempted': preempted[p], 'silent': silent}
     return out
 
 
@@ -482,6 +493,14 @@ def render_analysis(res, max_timeline=40):
                                        b['name']))
 
     hb = res.get('heartbeat', {})
+    pre = [p for p, st in hb.items() if st.get('preempted')]
+    if pre:
+        w('-- PREEMPTED PROCESSES (announced a clean SIGTERM exit) --')
+        for p in pre:
+            st = hb[p]
+            extra = '' if st.get('gap_s') is None else \
+                ' — last heard %.1f s before the trace end' % st['gap_s']
+            w('  pid %-8s requested preemption%s' % (p, extra))
     silent = [p for p, st in hb.items() if st.get('silent')]
     if silent:
         w('-- SILENT PROCESSES (heartbeat stopped before trace end) --')
@@ -490,7 +509,7 @@ def render_analysis(res, max_timeline=40):
             w('  pid %-8s last heard %.1f s before the trace end '
               '(heartbeat every %.1f s) — killed or wedged'
               % (p, st['gap_s'], st['hb_interval_s']))
-    elif any(st.get('hb_count') for st in hb.values()):
+    elif not pre and any(st.get('hb_count') for st in hb.values()):
         w('heartbeats: all %d processes alive to the end of the trace'
           % len(hb))
     return '\n'.join(out) + '\n'

@@ -315,6 +315,47 @@ def resilience_summary(root, now=None):
     return out
 
 
+def fleet_summary(root, now=None):
+    """Fleet-survivability posture for the round record
+    (nbodykit_tpu.resilience.fleet, docs/RESILIENCE.md): how many
+    committed records came from preempted or shrunk-and-re-formed
+    runs, and the state of the coordinated checkpoint directory —
+    sealed vs incomplete (shards without a manifest: a seal
+    interrupted mid-commit) vs orphaned ``*.tmp`` debris.  Never
+    raises."""
+    now = time.time() if now is None else now
+    out = {'preempted_records': 0, 'reformed_records': 0,
+           'reformations': []}
+    for fname in ('BENCH_STAGED.json',) + CACHE_FILES:
+        try:
+            with open(os.path.join(root, fname)) as f:
+                recs = json.load(f).get('results', {})
+        except (OSError, ValueError):
+            continue
+        for rec in recs.values():
+            if not isinstance(rec, dict):
+                continue
+            if rec.get('preempted'):
+                out['preempted_records'] += 1
+            if rec.get('reformed_from'):
+                out['reformed_records'] += 1
+                out['reformations'].append(
+                    {'metric': rec.get('metric'),
+                     'reformed_from': rec.get('reformed_from'),
+                     'reformed_to': rec.get('reformed_to')})
+    ckpt_dir = os.path.join(root, 'BENCH_CKPT')
+    if os.path.isdir(ckpt_dir):
+        try:
+            from ..resilience import FleetCheckpointStore
+            survey = FleetCheckpointStore(ckpt_dir).survey()
+            out['sealed_manifests'] = survey.get('sealed', 0)
+            out['incomplete_seqs'] = survey.get('incomplete', 0)
+            out['orphan_tmp'] = survey.get('orphan_tmp', 0)
+        except Exception as e:     # pragma: no cover - defensive
+            out['error'] = str(e)
+    return out
+
+
 def serve_summary(root):
     """Serving posture for the round record: the latest committed
     ``servetrace_*`` bench record (nbodykit_tpu.serve via ``bench.py
@@ -380,6 +421,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'lint': lint_summary(root),
         'tune': tune_summary(root, now=now),
         'resilience': resilience_summary(root, now=now),
+        'fleet': fleet_summary(root, now=now),
         'serve': serve_summary(root),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
@@ -439,6 +481,29 @@ def render_regress(history):
                            res.get('oldest_checkpoint_hours', '?')))
         if bits:
             w('  resilience: %s' % '; '.join(bits))
+    fleet = history.get('fleet')
+    if fleet is not None:
+        bits = []
+        if fleet.get('preempted_records'):
+            bits.append('%d record(s) interrupted by preemption'
+                        % fleet['preempted_records'])
+        for rf in fleet.get('reformations') or []:
+            bits.append('%s resumed with a SHRUNK mesh (%s -> %s '
+                        'ranks)' % (rf.get('metric', '?'),
+                                    rf.get('reformed_from', '?'),
+                                    rf.get('reformed_to', '?')))
+        if fleet.get('incomplete_seqs'):
+            bits.append('%d INCOMPLETE manifest seq(s) — a seal died '
+                        'mid-commit; the previous sealed manifest is '
+                        'authoritative' % fleet['incomplete_seqs'])
+        if fleet.get('orphan_tmp'):
+            bits.append('%d orphaned .tmp file(s) (gc candidates)'
+                        % fleet['orphan_tmp'])
+        if fleet.get('sealed_manifests'):
+            bits.append('%d sealed manifest(s) on disk'
+                        % fleet['sealed_manifests'])
+        if bits:
+            w('  fleet: %s' % '; '.join(bits))
     serve = history.get('serve')
     if serve is not None:
         if 'error' in serve:

@@ -128,6 +128,23 @@ def trace_state_clean():
         return True
 
 
+def fleet_rank_hint():
+    """This process's fleet rank from the environment
+    (``NBKIT_FLEET_RANK`` / ``JAX_PROCESS_ID``), or None.  Env-only on
+    purpose: the tracer (and its heartbeat thread) must never trigger
+    jax backend initialization.  Stamped into ``meta``/``hb`` records
+    so the live failure detector (resilience/fleet.py) can map a pid
+    to the rank it must re-form without."""
+    for var in ('NBKIT_FLEET_RANK', 'JAX_PROCESS_ID'):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return None
+
+
 class _Span(object):
     """One timed, nested region.  Attributes set via constructor or
     :meth:`set` land in the trace record's ``attrs``."""
@@ -214,10 +231,14 @@ class Tracer(object):
                 'NBKIT_DIAGNOSTICS_HEARTBEAT', '5') or 0)
         except ValueError:
             self.heartbeat_s = 5.0
-        self._emit({'t': 'meta', 'version': 1, 'pid': self.pid,
-                    'ts': round(time.time(), 6),
-                    'argv': [str(a) for a in getattr(sys, 'argv', [])],
-                    'heartbeat_s': self.heartbeat_s})
+        meta = {'t': 'meta', 'version': 1, 'pid': self.pid,
+                'ts': round(time.time(), 6),
+                'argv': [str(a) for a in getattr(sys, 'argv', [])],
+                'heartbeat_s': self.heartbeat_s}
+        rank = fleet_rank_hint()
+        if rank is not None:
+            meta['rank'] = rank
+        self._emit(meta)
         self._hb_stop = threading.Event()
         if self.heartbeat_s > 0:
             t = threading.Thread(target=self._hb_loop, daemon=True,
@@ -260,9 +281,15 @@ class Tracer(object):
         while not self._hb_stop.wait(self.heartbeat_s):
             if self._f.closed:
                 return
-            self._emit({'t': 'hb', 'pid': self.pid,
-                        'ts': round(time.time(), 6),
-                        'iv': self.heartbeat_s}, sync=False)
+            rec = {'t': 'hb', 'pid': self.pid,
+                   'ts': round(time.time(), 6),
+                   'iv': self.heartbeat_s}
+            # re-read per beat: launchers/workers may export the rank
+            # after the tracer came up
+            rank = fleet_rank_hint()
+            if rank is not None:
+                rec['rank'] = rank
+            self._emit(rec, sync=False)
 
     def _at_exit(self):
         # end-of-run summary on clean interpreter exit (a crash relies

@@ -88,6 +88,32 @@ def process_index():
     return jax.process_index()
 
 
+def process_count():
+    """Number of processes in the multi-host job (1 on one host) —
+    the fleet size coordinated checkpoints shard over."""
+    return jax.process_count()
+
+
+def reform_decomposition(old_nranks, new_nranks, ndev_per_rank=None):
+    """The shrink-to-survive mesh plan when a relaunch runs with
+    ``new_nranks`` processes instead of ``old_nranks``: the slab
+    re-slices (rank r of the new fleet takes its contiguous span of
+    the concatenated rows — resilience/fleet.py ``repartition``), and
+    the pencil factorization is re-derived from the surviving device
+    count via :func:`default_pencil_factor`.  Returns the dict the
+    resumed run stamps into its records (``reformed_from`` /
+    ``reformed_to`` plus the pencil factors when the per-rank device
+    count is known)."""
+    out = {'reformed_from': int(old_nranks),
+           'reformed_to': int(new_nranks)}
+    if ndev_per_rank:
+        out['pencil_from'] = list(default_pencil_factor(
+            int(old_nranks) * int(ndev_per_rank)))
+        out['pencil_to'] = list(default_pencil_factor(
+            int(new_nranks) * int(ndev_per_rank)))
+    return out
+
+
 def single_device_mesh(device=None):
     """A 1-device mesh (collectives become no-ops)."""
     if device is None:

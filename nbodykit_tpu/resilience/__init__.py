@@ -24,7 +24,16 @@ in.  Three pieces:
   (``set_options(faults='point@N:action')`` / ``$NBKIT_FAULTS``):
   raise a real ``XlaRuntimeError`` of a chosen status at the Nth call
   to a named :func:`fault_point`, or SIGKILL at a named checkpoint —
-  every recovery path is testable on the CPU mesh in tier-1.
+  every recovery path is testable on the CPU mesh in tier-1.  Rank-
+  scoped rules (``rank1@bench.rep:sigkill``) and signal actions form
+  the fleet chaos matrix.
+- :mod:`.fleet` — fleet survivability on top of the three:
+  coordinated manifest-sealed multi-rank checkpoints
+  (:class:`FleetCheckpointStore`), SIGTERM preemption handling inside
+  a grace budget (:func:`install_preemption_handler` /
+  :class:`Preempted`), a live heartbeat failure detector
+  (:class:`FleetMonitor`), and shrink-to-survive shard repartitioning
+  for relaunches with fewer processes.
 
 Wired in: ``bench.py``'s measurement reps checkpoint after every rep
 and resume on relaunch (records carry ``resumed: true``); the
@@ -38,6 +47,13 @@ from .checkpoint import CheckpointStore  # noqa: F401
 from .faults import (ACTIONS, InjectedFault, error_class,  # noqa: F401
                      fault_counts, fault_point, parse_spec,
                      reset_faults)
+from .fleet import (DEAD_RANK_EXIT, PREEMPTED_EXIT,  # noqa: F401
+                    FleetCheckpointStore, FleetMonitor, FleetSealError,
+                    Preempted, check_preemption,
+                    clear_preemption, fleet_barrier, fleet_rank,
+                    fleet_size, install_preemption_handler,
+                    preemption_requested, reassemble, repartition,
+                    scan_liveness, uninstall_preemption_handler)
 from .supervise import (DEADLINE, FATAL, OOM, TRANSIENT,  # noqa: F401
                         DegradationLadder, RetryPolicy, Supervisor,
                         classify_error, default_ladder, scoped_ladder)

@@ -202,6 +202,43 @@ class CheckpointStore(object):
             except OSError:
                 pass
 
+    # -- retention --------------------------------------------------------
+
+    def orphan_tmp(self, max_age_s=0.0, now=None):
+        """Paths of ``*.tmp.<pid>`` siblings at least ``max_age_s`` old
+        — debris a kill mid-commit leaves behind (the rename never
+        happened, so they are invisible to load; they only waste
+        disk)."""
+        now = time.time() if now is None else now
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for f in names:
+            if '.tmp.' not in f:
+                continue
+            path = os.path.join(self.root, f)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if age >= max_age_s:
+                out.append(path)
+        return sorted(out)
+
+    def gc_tmp(self, max_age_s=3600.0, now=None):
+        """Remove stale tmp orphans; returns the count removed.  The
+        default age spares a concurrent writer's in-flight tmp."""
+        n = 0
+        for path in self.orphan_tmp(max_age_s=max_age_s, now=now):
+            try:
+                os.remove(path)
+                n += 1
+            except OSError:
+                pass
+        return n
+
     # -- freshness --------------------------------------------------------
 
     def saved_at(self, key):

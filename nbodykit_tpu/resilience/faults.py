@@ -9,21 +9,32 @@ by ordinary tests instead of waiting for the hardware to misbehave.
 
 Spec format (``set_options(faults=...)`` or ``$NBKIT_FAULTS``):
 
-    point@N:action[,point@N:action...]
+    [rankR@]point[@N]:action[,...]
 
 ``point`` names a fault point (a host-side call site instrumented
 with :func:`fault_point` — e.g. ``bench.rep``, ``ckpt.write.<key>``,
-``<supervisor>.attempt``), ``N`` is the 1-based call count at which
-the rule fires (default 1), and ``action`` is one of:
+``ckpt.manifest``, ``<supervisor>.attempt``), ``N`` is the 1-based
+call count at which the rule fires (default 1), and ``action`` is one
+of:
 
 - ``unavailable`` / ``resource_exhausted`` / ``deadline`` /
   ``internal`` — raise a real ``XlaRuntimeError`` (the class jax's
   runtime raises; a plain RuntimeError subclass when jax is absent)
   whose message carries the canonical gRPC status prefix, so error
   classification sees exactly what the fleet produces;
-- ``kill`` — ``SIGKILL`` this process on the spot (no atexit, no
-  flush): the checkpoint-atomicity and resume paths see a true
-  mid-run death.
+- ``kill`` / ``sigkill`` — ``SIGKILL`` this process on the spot (no
+  atexit, no flush): the checkpoint-atomicity and resume paths see a
+  true mid-run death;
+- ``sigterm`` — deliver a real SIGTERM to this process and *return*:
+  with the preemption handler installed (:mod:`.fleet`) execution
+  continues to the next safe point exactly as under a preemptible
+  scheduler; without one the default disposition terminates.
+
+The optional ``rankR@`` prefix scopes a rule to one fleet rank
+(``rank1@bench.rep:sigkill`` kills only rank 1), which is how the
+chaos matrix kills chosen ranks of a multi-process fleet.  Call
+*counting* stays rank-uniform — every process counts every targeted
+point — so all ranks agree on the call index a rule names.
 
 Each rule fires exactly once (the call count passes ``N`` once per
 process).  Calls to points no rule targets cost one string lookup.
@@ -33,6 +44,7 @@ everywhere — collective-consistent by construction.
 """
 
 import os
+import re
 import signal
 import threading
 
@@ -50,7 +62,9 @@ _STATUS_MESSAGES = {
     'deadline': 'DEADLINE_EXCEEDED: injected fault at %s (call %d)',
     'internal': 'INTERNAL: injected fault at %s (call %d)',
 }
-ACTIONS = tuple(_STATUS_MESSAGES) + ('kill',)
+ACTIONS = tuple(_STATUS_MESSAGES) + ('kill', 'sigkill', 'sigterm')
+
+_RANK_RE = re.compile(r'^rank(\d+)$')
 
 
 class InjectedFault(RuntimeError):
@@ -81,9 +95,10 @@ def _spec():
 
 
 def parse_spec(spec):
-    """``[(point, nth, action), ...]`` for a spec string; raises
-    ValueError on malformed rules (a typo'd spec must not silently
-    inject nothing)."""
+    """``[(point, nth, action), ...]`` for a spec string — rank-scoped
+    rules (``rankR@point[@N]:action``) parse to 4-tuples ``(point,
+    nth, action, rank)``; raises ValueError on malformed rules (a
+    typo'd spec must not silently inject nothing)."""
     rules = []
     for part in str(spec).split(','):
         part = part.strip()
@@ -99,12 +114,19 @@ def parse_spec(spec):
                              '(choose %s)' % (part, action,
                                               '/'.join(ACTIONS)))
         point, at, nth = name.partition('@')
+        rank = None
+        m = _RANK_RE.match(point.strip())
+        if m is not None and at:
+            rank = int(m.group(1))
+            point, at, nth = nth.partition('@')
         try:
             n = int(nth) if at else 1
         except ValueError:
             raise ValueError('fault rule %r: call count %r is not an '
                              'integer' % (part, nth))
-        rules.append((point.strip(), n, action))
+        point = point.strip()
+        rules.append((point, n, action) if rank is None
+                     else (point, n, action, rank))
     return rules
 
 
@@ -139,7 +161,8 @@ def fault_counts():
 def fault_point(name):
     """Declare a named fault point.  Free when no spec is configured
     or no rule targets ``name``; otherwise counts the call and fires
-    any rule matching (name, count)."""
+    any rule matching (name, count) — rank-scoped rules only on their
+    fleet rank, though every rank counts the call."""
     rules = _rules()
     if not rules:
         return
@@ -148,11 +171,22 @@ def fault_point(name):
         return
     with _lock:
         n = _counts[name] = _counts.get(name, 0) + 1
-    for _, nth, action in mine:
+    for rule in mine:
+        nth, action = rule[1], rule[2]
         if nth != n:
             continue
-        if action == 'kill':
+        if len(rule) > 3:
+            from .fleet import fleet_rank
+            if fleet_rank() != rule[3]:
+                continue
+        if action in ('kill', 'sigkill'):
             # no flush, no atexit: the genuine mid-run death
             os.kill(os.getpid(), signal.SIGKILL)
         counter('resilience.faults.injected').add(1)
+        if action == 'sigterm':
+            # the real signal, then return: the preemption handler
+            # sees exactly what a preemptible scheduler sends and the
+            # run continues to its next safe point
+            os.kill(os.getpid(), signal.SIGTERM)
+            continue
         raise error_class()(_STATUS_MESSAGES[action] % (name, n))

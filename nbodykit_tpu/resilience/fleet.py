@@ -1,0 +1,908 @@
+"""Fleet survivability: coordinated checkpoints, preemption, live
+failure detection, and shrink-to-survive resume.
+
+The single-process resilience story (:mod:`.checkpoint`,
+:mod:`.supervise`, :mod:`.faults`) keeps ONE interpreter's progress
+safe.  The hardware campaign (ROADMAP item 1) runs on preemptible
+multi-host slices, where the failure model is the classic MPI one: any
+rank dying takes the whole collective program with it — and today it
+takes the recorded evidence too.  Four pieces close that gap:
+
+- **Coordinated checkpoints** (:class:`FleetCheckpointStore`): every
+  rank commits its shard through the atomic tmp+rename+sha256
+  machinery of :class:`.checkpoint.CheckpointStore`, then the fleet
+  rendezvouses — an allgather of shard-hash digests proves every rank
+  landed — and only then does rank 0 atomically write a *manifest*
+  that seals the sequence number.  A checkpoint without a sealed
+  manifest does not exist: a kill anywhere mid-commit leaves the
+  previous manifest authoritative.
+- **Preemption handling**: :func:`install_preemption_handler` turns
+  SIGTERM (what preemptible schedulers send) into a request honored at
+  the next safe point (:func:`check_preemption` raises
+  :class:`Preempted`) inside a grace budget; at the deadline a daemon
+  timer force-exits with :data:`PREEMPTED_EXIT` so the scheduler never
+  has to escalate to SIGKILL.  The request is announced as a
+  ``resilience.preempted`` event + counter, which is also how the
+  post-mortem analyzer distinguishes a clean preemption from a silent
+  death.
+- **Live failure detection** (:class:`FleetMonitor`): a daemon thread
+  tails the per-process ``hb`` heartbeat records (diagnostics/trace.py)
+  that were previously post-mortem-only, declares a peer dead after a
+  configurable gap, and — because the main thread is typically wedged
+  inside a gloo/ICI collective the dead peer will never enter —
+  aborts the process (:data:`DEAD_RANK_EXIT`) instead of hanging until
+  the distributed runtime's own multi-minute timeout.
+- **Shrink-to-survive resume**: :meth:`FleetCheckpointStore.load`
+  repartitions the surviving manifest's per-rank shards onto a new
+  (smaller or larger) rank count — concatenate along the slab axis,
+  re-slice — so a relaunch with fewer processes re-forms a valid mesh
+  and resumes instead of restarting.
+
+Everything here is host-side and importable without jax (the
+collective rendezvous imports jax lazily); the chaos matrix that
+drives the end-to-end test lives in :mod:`.faults` (rank-scoped rules
+like ``rank1@bench.rep:sigkill``).  Full guide: docs/RESILIENCE.md.
+"""
+
+import functools
+import json
+import os
+import re
+import signal
+import sys
+import threading
+import time
+
+from ..diagnostics import counter, current_tracer, span
+from .checkpoint import CheckpointStore, _atomic_bytes, _canonical, \
+    _safe, _sha
+
+# distinct exit codes so launchers/relaunch loops can tell a clean
+# preemption (resume and continue) from a detected dead peer (re-form
+# the fleet) from an ordinary crash.  75/76 follow the BSD sysexits
+# "temporary failure" neighborhood without colliding with shell or
+# signal codes (128+N).
+PREEMPTED_EXIT = 75
+DEAD_RANK_EXIT = 76
+
+
+class Preempted(RuntimeError):
+    """Raised at a safe point after SIGTERM requested preemption."""
+
+
+class FleetSealError(RuntimeError):
+    """A coordinated checkpoint failed its seal rendezvous: some rank's
+    shard is missing or hash-divergent.  FATAL to classification — a
+    torn fleet checkpoint must not be retried blindly."""
+
+
+# ---------------------------------------------------------------------------
+# fleet identity
+
+def fleet_rank():
+    """This process's fleet rank: ``$NBKIT_FLEET_RANK``, else
+    ``$JAX_PROCESS_ID``, else ``jax.process_index()`` when jax is
+    already imported, else 0.  Environment first, so host-side tools
+    (and fault rules evaluated before jax initializes) agree with the
+    launcher."""
+    for var in ('NBKIT_FLEET_RANK', 'JAX_PROCESS_ID'):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    jax = sys.modules.get('jax')
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    return 0
+
+
+def fleet_size():
+    """Number of processes in the fleet (same resolution order as
+    :func:`fleet_rank`; 1 when nothing says otherwise)."""
+    for var in ('NBKIT_FLEET_SIZE', 'JAX_NUM_PROCESSES'):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    jax = sys.modules.get('jax')
+    if jax is not None:
+        try:
+            return int(jax.process_count())
+        except Exception:
+            pass
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM -> safe-point Preempted inside a grace budget
+
+_preempt_lock = threading.Lock()
+_preempt = {'prev_handler': None, 'grace_s': 30.0,
+            'exit_code': PREEMPTED_EXIT, 'requested_at': None,
+            'deadline': None, 'announced': False}
+
+
+def install_preemption_handler(grace_s=30.0, exit_code=PREEMPTED_EXIT):
+    """Install the SIGTERM handler (main thread only, per the signal
+    module's contract).  Idempotent; re-installing updates the grace
+    budget.  The handler itself only records the request and arms the
+    grace-deadline force-exit — the checkpoint/seal work happens at the
+    next :func:`check_preemption` safe point, in ordinary context."""
+    with _preempt_lock:
+        _preempt['grace_s'] = float(grace_s)
+        _preempt['exit_code'] = int(exit_code)
+        if _preempt['prev_handler'] is None:
+            prev = signal.signal(signal.SIGTERM, _on_sigterm)
+            _preempt['prev_handler'] = prev if prev is not None \
+                else signal.SIG_DFL
+
+
+def uninstall_preemption_handler():
+    """Restore the previous SIGTERM disposition and clear any pending
+    request (test isolation)."""
+    with _preempt_lock:
+        prev = _preempt['prev_handler']
+        _preempt['prev_handler'] = None
+        _preempt.update(requested_at=None, deadline=None,
+                        announced=False)
+    if prev is not None:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def _on_sigterm(signum, frame):
+    # Runs as a deferred Python-level handler on the main thread, which
+    # may be interrupted INSIDE tracer/file locks — so no emitting
+    # here.  The announce runs on its own thread; the grace timer is
+    # the force-exit backstop the scheduler's kill would otherwise be.
+    now = time.time()
+    with _preempt_lock:
+        if _preempt['requested_at'] is not None:
+            return
+        _preempt['requested_at'] = now
+        grace = _preempt['grace_s']
+        _preempt['deadline'] = now + grace
+    threading.Thread(target=_announce_preemption, daemon=True,
+                     name='nbkit-preempt-announce').start()
+    t = threading.Timer(grace, _grace_expired)
+    t.daemon = True
+    t.start()
+
+
+def _announce_preemption():
+    """Emit the ``resilience.preempted`` counter + trace event exactly
+    once per request (the analyzer keys the preempted-vs-silent
+    distinction on this event)."""
+    with _preempt_lock:
+        if _preempt['announced'] or _preempt['requested_at'] is None:
+            return
+        _preempt['announced'] = True
+        grace = _preempt['grace_s']
+        deadline = _preempt['deadline']
+    counter('resilience.preempted').add(1)
+    tr = current_tracer()
+    if tr is not None:
+        tr.event('resilience.preempted',
+                 {'grace_s': grace, 'deadline': round(deadline, 3)})
+
+
+def _grace_expired():
+    with _preempt_lock:
+        if _preempt['requested_at'] is None:
+            return
+        code = _preempt['exit_code']
+    counter('resilience.preempt_forced').add(1)
+    tr = current_tracer()
+    if tr is not None:
+        tr.event('resilience.preempt_forced', {'exit_code': code})
+        tr.close()
+    os._exit(code)
+
+
+def preemption_requested():
+    """True once SIGTERM arrived (checked lock-free on hot paths)."""
+    return _preempt['requested_at'] is not None
+
+
+def preemption_deadline():
+    """Epoch seconds of the grace deadline, or None."""
+    return _preempt['deadline']
+
+
+def clear_preemption():
+    """Forget a pending request (test isolation; the handler stays)."""
+    with _preempt_lock:
+        _preempt.update(requested_at=None, deadline=None,
+                        announced=False)
+
+
+def check_preemption(label=None):
+    """The safe point: raise :class:`Preempted` when a SIGTERM arrived.
+    Call where progress has just been checkpointed — between bench
+    reps, between serve requests — so the exit loses nothing."""
+    if _preempt['requested_at'] is None:
+        return
+    _announce_preemption()
+    left = (_preempt['deadline'] or 0) - time.time()
+    raise Preempted('preemption requested at %s (%.1f s of grace left)'
+                    % (label or 'safe point', max(left, 0.0)))
+
+
+# ---------------------------------------------------------------------------
+# collective rendezvous (jax imported lazily; single-process callers
+# pass mesh=None and never touch it)
+
+@functools.lru_cache(maxsize=8)
+def _allgather_for(mesh, width):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.runtime import leading_axes
+    lead = leading_axes(mesh)
+    return jax.jit(jax.shard_map(
+        lambda v: jax.lax.all_gather(v, lead, axis=0, tiled=True),
+        mesh=mesh, in_specs=P(lead), out_specs=P()))
+
+
+@functools.lru_cache(maxsize=8)
+def _allsum_for(mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.runtime import leading_axes
+    lead = leading_axes(mesh)
+    return jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(jnp.sum(v), lead), mesh=mesh,
+        in_specs=P(lead), out_specs=P()))
+
+
+def _device_rows(mesh, row):
+    """Place ``row`` (one int32 vector, identical across this
+    process's devices) as a device-sharded (ndev, width) array."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.runtime import leading_axes
+    row = np.ascontiguousarray(row, 'int32').ravel()
+    ndev = int(mesh.devices.size)
+    full = np.tile(row, (ndev, 1))
+    sh = NamedSharding(mesh, P(leading_axes(mesh)))
+    # the callback only materializes THIS process's shards, so every
+    # process contributes its own row without seeing the others'
+    return jax.make_array_from_callback((ndev, row.size), sh,
+                                        lambda idx: full[idx])
+
+
+def fleet_allgather(mesh, row):
+    """All-gather one small int32 row per process over ``mesh``;
+    returns the rows ordered by process index (one per process, the
+    duplicate per-device copies collapsed).  This is the seal
+    rendezvous primitive: every process calls it unconditionally, so
+    the fleet's collective order stays rank-uniform."""
+    import numpy as np
+    arr = _device_rows(mesh, row)
+    out = np.asarray(_allgather_for(mesh, arr.shape[1])(arr))
+    rows = {}
+    for i, d in enumerate(mesh.devices.flatten()):
+        rows.setdefault(int(d.process_index), out[i])
+    return [rows[p] for p in sorted(rows)]
+
+
+def fleet_barrier(mesh, tag):
+    """An explicit fleet-wide sync point wrapped in a ``barrier`` span
+    (the analyzer's clock-alignment anchor): a replicated psum every
+    process leaves together."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.runtime import leading_axes
+    ndev = int(mesh.devices.size)
+    sh = NamedSharding(mesh, P(leading_axes(mesh)))
+    ones = np.ones((ndev,), 'f4')
+    x = jax.make_array_from_callback((ndev,), sh, lambda idx: ones[idx])
+    allsum = _allsum_for(mesh)
+    with span('barrier', point=str(tag)):
+        total = float(allsum(x))
+    assert total == ndev, (tag, total, ndev)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# coordinated checkpoints: per-rank shards + rank-0 sealed manifest
+
+def reassemble(per_rank_arrays):
+    """Concatenate per-rank array dicts (rank order) along axis 0 —
+    the slab convention every fleet shard follows."""
+    import numpy as np
+    if not per_rank_arrays:
+        return {}
+    names = sorted(per_rank_arrays[0])
+    return {name: np.concatenate([np.asarray(d[name])
+                                  for d in per_rank_arrays], axis=0)
+            for name in names}
+
+
+def repartition(per_rank_arrays, new_nranks):
+    """Re-slice per-rank shard arrays onto ``new_nranks`` ranks: the
+    shrink-to-survive transform.  Returns a list of array dicts, one
+    per new rank (slab re-slice; a pencil relaunch re-factorizes its
+    device mesh separately via ``default_pencil_factor``)."""
+    import numpy as np
+    full = reassemble(per_rank_arrays)
+    new_nranks = int(new_nranks)
+    out = [dict() for _ in range(new_nranks)]
+    for name, arr in full.items():
+        for r, piece in enumerate(np.array_split(arr, new_nranks,
+                                                 axis=0)):
+            out[r][name] = piece
+    return out
+
+
+class FleetCheckpointStore(object):
+    """Coordinated multi-rank checkpoints over one directory.
+
+    Layout (all names pass through the base store's ``_safe``):
+
+    - ``<key>.m<seq>.rank<r>.ckpt.json`` (+ ``.npy`` payloads) — rank
+      ``r``'s shard for sequence ``seq``, committed atomically by
+      :class:`CheckpointStore`.
+    - ``<key>.m<seq>.manifest.json`` — the rank-0 seal: per-rank shard
+      hashes + decomposition, content-hashed itself, written only
+      after the allgather rendezvous proved every shard landed.  Its
+      rename is the fleet-wide commit point; :meth:`latest_manifest`
+      only ever trusts a verifying manifest whose shards verify too,
+      so a kill mid-commit leaves the previous seq authoritative.
+
+    ``seq`` must be rank-uniform (callers use the rep number)."""
+
+    _SHARD_RE = re.compile(
+        r'^(?P<fam>.+)\.m(?P<seq>\d+)\.rank(?P<rank>\d+)\.ckpt\.json$')
+    _MANIFEST_RE = re.compile(
+        r'^(?P<fam>.+)\.m(?P<seq>\d+)\.manifest\.json$')
+
+    def __init__(self, root, keep=3):
+        self.store = CheckpointStore(root)
+        self.root = self.store.root
+        self.keep = int(keep)
+
+    # -- naming -----------------------------------------------------------
+
+    def shard_key(self, key, seq, rank):
+        return '%s.m%04d.rank%d' % (key, int(seq), int(rank))
+
+    def _manifest_path(self, key, seq):
+        return os.path.join(self.root, '%s.m%04d.manifest.json'
+                            % (_safe(key), int(seq)))
+
+    # -- shard commit ------------------------------------------------------
+
+    def save_shard(self, key, seq, rank, nranks, state, arrays=None):
+        """Commit this rank's shard (atomic via the base store).  The
+        user state is wrapped with the fleet coordinates so a shard
+        can never be replayed under the wrong decomposition."""
+        wrapped = {'fleet': {'key': str(key), 'seq': int(seq),
+                             'rank': int(rank), 'nranks': int(nranks)},
+                   'user': state}
+        skey = self.shard_key(key, seq, rank)
+        self.store.save(skey, wrapped, arrays=arrays)
+        return skey
+
+    def _shard_sha(self, key, seq, rank):
+        """The committed shard's content hash (metadata ``sha256``),
+        or None when the shard has not landed."""
+        path = self.store._meta_path(self.shard_key(key, seq, rank))
+        try:
+            with open(path) as f:
+                return json.load(f).get('sha256')
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _sha_words(sha_hex):
+        """The first 64 hash bits as two non-negative int32 words —
+        the form that rides the seal allgather."""
+        return (int(sha_hex[:8], 16) & 0x7fffffff,
+                int(sha_hex[8:16], 16) & 0x7fffffff)
+
+    def _digest_row(self, key, seq, rank, nranks):
+        sha = self._shard_sha(key, seq, rank)
+        w0, w1 = self._sha_words(sha) if sha else (-1, -1)
+        return [int(seq), int(rank), int(nranks), w0, w1]
+
+    def _verify_rows(self, key, seq, nranks, rows):
+        """None when every rank's shard landed and the wire digests
+        match the on-disk hashes; else the reason string.  Never
+        raises — the caller sequences the raise AFTER the seal barrier
+        so the fleet's collective order stays rank-uniform."""
+        seen = {}
+        for row in rows:
+            vals = [int(v) for v in row]
+            seen[vals[1]] = vals
+        for r in range(int(nranks)):
+            vals = seen.get(r)
+            if vals is None:
+                return 'rank %d missing from the seal rendezvous' % r
+            if vals[0] != int(seq):
+                return 'rank %d rendezvoused seq %d, expected %d' \
+                    % (r, vals[0], int(seq))
+            if vals[2] != int(nranks):
+                return 'rank %d sees %d ranks, expected %d' \
+                    % (r, vals[2], int(nranks))
+            sha = self._shard_sha(key, seq, r)
+            if sha is None:
+                return 'rank %d shard not committed' % r
+            if tuple(vals[3:5]) != self._sha_words(sha):
+                return 'rank %d shard hash diverges from its ' \
+                    'rendezvous digest' % r
+        return None
+
+    def _write_manifest(self, key, seq, nranks, decomp):
+        shards = {}
+        for r in range(int(nranks)):
+            skey = self.shard_key(key, seq, r)
+            shards[str(r)] = {
+                'key': skey,
+                'file': os.path.basename(self.store._meta_path(skey)),
+                'sha256': self._shard_sha(key, seq, r),
+            }
+        body = _canonical({'key': str(key), 'seq': int(seq),
+                           'nranks': int(nranks), 'decomp': decomp,
+                           'shards': shards})
+        man = {'v': 1, 'key': str(key), 'seq': int(seq),
+               'nranks': int(nranks), 'decomp': decomp,
+               'shards': shards, 'sealed_at': round(time.time(), 6),
+               'sha256': _sha(body)}
+        path = self._manifest_path(key, seq)
+        from .faults import fault_point
+        # pre-commit fault points: a kill here proves the previous
+        # manifest stays authoritative (chaos rule ckpt.manifest)
+        fault_point('ckpt.manifest')
+        fault_point('ckpt.manifest.%s' % key)
+        _atomic_bytes(path, json.dumps(man, indent=1,
+                                       default=str).encode('utf-8'))
+        counter('resilience.fleet.manifests_sealed').add(1)
+        fault_point('ckpt.manifest.sealed')
+        return path
+
+    def seal(self, key, seq, nranks=None, mesh=None, rank=None,
+             decomp=None):
+        """Seal sequence ``seq``: rendezvous (allgather of shard
+        digests over ``mesh``), verify every rank landed, rank 0
+        writes the manifest, then a fleet barrier so no rank runs
+        ahead of an unsealed checkpoint.  All-or-nothing: any missing
+        or divergent shard raises :class:`FleetSealError` on every
+        rank — after the barrier, so the collective order never
+        branches.  ``mesh=None`` verifies against the shared
+        filesystem alone (single-process fleets, tests)."""
+        rank = fleet_rank() if rank is None else int(rank)
+        nranks = fleet_size() if nranks is None else int(nranks)
+        with span('fleet.seal', key=str(key), seq=int(seq),
+                  nranks=nranks):
+            if mesh is None:
+                rows = [self._digest_row(key, seq, r, nranks)
+                        for r in range(nranks)]
+                err = self._verify_rows(key, seq, nranks, rows)
+                if err is None and rank == 0:
+                    self._write_manifest(key, seq, nranks, decomp)
+            else:
+                row = self._digest_row(key, seq, rank, nranks)
+                rows = fleet_allgather(mesh, row)
+                err = self._verify_rows(key, seq, nranks, rows)
+                if err is None and rank == 0:
+                    self._write_manifest(key, seq, nranks, decomp)
+                fleet_barrier(mesh, 'fleet.seal')
+        if err is not None:
+            counter('resilience.fleet.seal_failed').add(1)
+            raise FleetSealError('fleet seal %s.m%04d: %s'
+                                 % (key, int(seq), err))
+        return int(seq)
+
+    def save(self, key, state, arrays=None, mesh=None, seq=None,
+             rank=None, nranks=None, decomp=None):
+        """Shard commit + seal in one call.  ``seq`` defaults to
+        :meth:`next_seq` — fine on one process; multi-rank callers
+        must pass a rank-uniform ``seq`` (the rep number)."""
+        rank = fleet_rank() if rank is None else int(rank)
+        nranks = fleet_size() if nranks is None else int(nranks)
+        if seq is None:
+            seq = self.next_seq(key)
+        self.save_shard(key, seq, rank, nranks, state, arrays=arrays)
+        self.seal(key, seq, nranks=nranks, mesh=mesh, rank=rank,
+                  decomp=decomp)
+        return int(seq)
+
+    # -- manifests ---------------------------------------------------------
+
+    def manifest_seqs(self, key):
+        """Sequence numbers with a manifest file on disk, ascending
+        (verification happens at :meth:`manifest` time)."""
+        rx = re.compile(r'^%s\.m(\d+)\.manifest\.json$'
+                        % re.escape(_safe(key)))
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(int(m.group(1)) for f in names
+                      for m in [rx.match(f)] if m)
+
+    def next_seq(self, key):
+        """1 + the highest seq any manifest OR shard file mentions, so
+        a relaunch never reuses a seq that has kill debris."""
+        fam = _safe(key)
+        seqs = set(self.manifest_seqs(key))
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            names = []
+        for f in names:
+            m = self._SHARD_RE.match(f)
+            if m and m.group('fam') == fam:
+                seqs.add(int(m.group('seq')))
+        return (max(seqs) + 1) if seqs else 1
+
+    def manifest(self, key, seq):
+        """The verified manifest dict for ``seq``, or None (missing,
+        torn, or content-hash mismatch — counted as corrupt)."""
+        try:
+            with open(self._manifest_path(key, seq)) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            return None
+        body = _canonical({'key': man.get('key'), 'seq': man.get('seq'),
+                           'nranks': man.get('nranks'),
+                           'decomp': man.get('decomp'),
+                           'shards': man.get('shards')})
+        if _sha(body) != man.get('sha256'):
+            counter('resilience.checkpoint.corrupt').add(1)
+            return None
+        return man
+
+    def latest_manifest(self, key):
+        """The newest verifying manifest, or None.  A seq whose
+        manifest is torn (kill mid-seal) is skipped — the previous
+        sealed seq stays authoritative."""
+        for seq in reversed(self.manifest_seqs(key)):
+            man = self.manifest(key, seq)
+            if man is not None:
+                return man
+        return None
+
+    # -- restore -----------------------------------------------------------
+
+    def load_full(self, key):
+        """``(state, arrays, manifest)`` — the newest sealed checkpoint
+        reassembled across ranks (arrays concatenated along axis 0 in
+        rank order; state from rank 0, rank-uniform by construction).
+        None without a verifying manifest or with any corrupt shard."""
+        man = self.latest_manifest(key)
+        if man is None:
+            return None
+        per_rank = []
+        for r in range(int(man['nranks'])):
+            got = self.store.load(self.shard_key(key, man['seq'], r))
+            if got is None:
+                return None
+            per_rank.append(got)
+        state = (per_rank[0][0] or {}).get('user')
+        return state, reassemble([a for _, a in per_rank]), man
+
+    def load(self, key, rank=None, nranks=None):
+        """This rank's slice of the newest sealed checkpoint as
+        ``(state, arrays, info)``, or None.  Same rank count as the
+        manifest → the shard exactly as saved; a different count →
+        the shrink-to-survive repartition (``info`` carries
+        ``reformed_from``/``reformed_to`` for the record stamps)."""
+        rank = fleet_rank() if rank is None else int(rank)
+        nranks = fleet_size() if nranks is None else int(nranks)
+        man = self.latest_manifest(key)
+        if man is None:
+            return None
+        old = int(man['nranks'])
+        seq = int(man['seq'])
+        if nranks == old:
+            got = self.store.load(self.shard_key(key, seq, rank))
+            if got is None:
+                return None
+            wrapped, arrays = got
+            return ((wrapped or {}).get('user'), arrays,
+                    {'seq': seq, 'nranks': old, 'reformed': False})
+        per_rank = []
+        for r in range(old):
+            got = self.store.load(self.shard_key(key, seq, r))
+            if got is None:
+                return None
+            per_rank.append(got)
+        state = (per_rank[0][0] or {}).get('user')
+        mine = repartition([a for _, a in per_rank], nranks)[rank]
+        counter('resilience.fleet.reformed').add(1)
+        tr = current_tracer()
+        if tr is not None:
+            tr.event('resilience.fleet.reform',
+                     {'key': str(key), 'from': old, 'to': nranks})
+        return (state, mine,
+                {'seq': seq, 'nranks': nranks, 'reformed': True,
+                 'reformed_from': old, 'reformed_to': nranks})
+
+    # -- retention / observability ----------------------------------------
+
+    def survey(self):
+        """Inventory for the doctor/regress posture: per family the
+        sealed seqs and the *incomplete* ones (shards without a
+        manifest — kill debris), plus in-flight ``*.tmp.*`` files."""
+        fams, tmp = {}, 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            names = []
+        for f in names:
+            if '.tmp.' in f:
+                tmp += 1
+                continue
+            m = self._MANIFEST_RE.match(f)
+            if m:
+                fam = fams.setdefault(m.group('fam'),
+                                      {'sealed': set(), 'shards': {}})
+                fam['sealed'].add(int(m.group('seq')))
+                continue
+            m = self._SHARD_RE.match(f)
+            if m:
+                fam = fams.setdefault(m.group('fam'),
+                                      {'sealed': set(), 'shards': {}})
+                fam['shards'].setdefault(int(m.group('seq')),
+                                         set()).add(int(m.group('rank')))
+        families = {}
+        sealed = incomplete = 0
+        for name, info in sorted(fams.items()):
+            inc = sorted(s for s in info['shards']
+                         if s not in info['sealed'])
+            families[name] = {'sealed': sorted(info['sealed']),
+                              'incomplete': inc,
+                              'shards': {s: sorted(r) for s, r
+                                         in info['shards'].items()}}
+            sealed += len(info['sealed'])
+            incomplete += len(inc)
+        return {'families': families, 'sealed': sealed,
+                'incomplete': incomplete, 'orphan_tmp': tmp}
+
+    def gc(self, keep=None, tmp_age_s=3600.0, now=None):
+        """Retention: keep the newest ``keep`` sealed manifests per
+        family; drop superseded manifests + their shards, unsealed
+        shard seqs older than the newest seal (kill debris), and stale
+        ``*.tmp.*`` orphans.  Returns removal counts — the campaign's
+        BENCH_CKPT/ stops growing without bound."""
+        keep = self.keep if keep is None else int(keep)
+        keep = max(keep, 1)
+        sv = self.survey()
+        removed = {'manifests': 0, 'shards': 0, 'tmp': 0}
+        for fam, info in sv['families'].items():
+            sealed = info['sealed']
+            drop = set(sealed[:-keep])
+            newest = sealed[-1] if sealed else None
+            debris = set(s for s in info['incomplete']
+                         if newest is not None and s < newest)
+            for seq in sorted(drop):
+                try:
+                    os.remove(self._manifest_path(fam, seq))
+                    removed['manifests'] += 1
+                except OSError:
+                    pass
+            for seq in sorted(drop | debris):
+                for r in info['shards'].get(seq, ()):
+                    self.store.delete(self.shard_key(fam, seq, r))
+                    removed['shards'] += 1
+        removed['tmp'] = self.store.gc_tmp(max_age_s=tmp_age_s,
+                                           now=now)
+        total = sum(removed.values())
+        if total:
+            counter('resilience.fleet.gc_removed').add(total)
+        return removed
+
+    def delete(self, key):
+        """Remove every manifest + shard of ``key``'s family."""
+        fam = _safe(key)
+        info = self.survey()['families'].get(fam)
+        if info is None:
+            return
+        for seq in info['sealed']:
+            try:
+                os.remove(self._manifest_path(fam, seq))
+            except OSError:
+                pass
+        for seq, ranks in info['shards'].items():
+            for r in ranks:
+                self.store.delete(self.shard_key(fam, seq, r))
+
+
+# ---------------------------------------------------------------------------
+# live failure detection
+
+def scan_liveness(path, gap_s=None, now=None, exclude_pids=()):
+    """Per-process liveness from a LIVE trace directory.
+
+    Unlike ``analyze.heartbeat_report`` (post-mortem: gaps measured
+    against the trace end) this compares each process's last record
+    against the wall clock *now* — same-host clocks, which is what the
+    CPU fleet and per-host monitors see.  A process with a
+    ``resilience.preempted`` event is never ``dead`` (it announced a
+    clean exit); one traced without heartbeats makes no claim
+    (``dead: None``).  ``gap_s`` defaults to max(3·interval, 2 s).
+    """
+    from ..diagnostics.analyze import load_processes
+    procs, _ = load_processes(path)
+    now = time.time() if now is None else float(now)
+    skip = set(exclude_pids)
+    out = []
+    for pid in sorted(procs):
+        if pid in skip:
+            continue
+        last, iv, count, rank, preempted = None, None, 0, None, False
+        for r in procs[pid]:
+            ts = r.get('ts')
+            if ts is not None:
+                ts = float(ts)
+                last = ts if last is None else max(last, ts)
+            t = r.get('t')
+            if t == 'hb':
+                count += 1
+                iv = float(r.get('iv', 0)) or iv
+                if r.get('rank') is not None:
+                    rank = int(r['rank'])
+            elif t == 'meta':
+                if r.get('heartbeat_s'):
+                    iv = float(r['heartbeat_s'])
+                if r.get('rank') is not None:
+                    rank = int(r['rank'])
+            elif t == 'span' and r.get('name') == 'resilience.preempted':
+                preempted = True
+        gap = None if last is None else now - last
+        thresh = float(gap_s) if gap_s else \
+            (max(3.0 * iv, 2.0) if iv else None)
+        if preempted:
+            dead = False
+        elif iv and gap is not None and thresh is not None:
+            dead = gap > thresh
+        else:
+            dead = None
+        out.append({'pid': pid, 'rank': rank, 'last_seen': last,
+                    'gap_s': None if gap is None else round(gap, 6),
+                    'hb_count': count, 'hb_interval_s': iv,
+                    'preempted': preempted, 'dead': dead})
+    return out
+
+
+class FleetMonitor(object):
+    """Daemon thread declaring peers dead from their heartbeat gaps —
+    live, while this process may be wedged inside a collective the
+    dead peer will never enter.
+
+    With ``abort=True`` a detection flushes the tracer and
+    ``os._exit(exit_code)``s (default :data:`DEAD_RANK_EXIT`): the
+    only way out of a blocked gloo/ICI collective, and minutes faster
+    than the distributed runtime's own heartbeat timeout at any sane
+    ``gap_s``.  Only processes seen alive on this monitor's watch
+    (last record no older than start − gap) are ever declared — stale
+    trace files from an earlier incarnation are ignored.  The monitor
+    never raises into the watched process: scan errors are swallowed.
+    """
+
+    def __init__(self, path, gap_s=2.0, poll_s=None, on_dead=None,
+                 abort=False, exit_code=DEAD_RANK_EXIT,
+                 exclude_pids=()):
+        self.path = str(path)
+        self.gap_s = float(gap_s)
+        self.poll_s = float(poll_s) if poll_s \
+            else max(self.gap_s / 4.0, 0.05)
+        self.on_dead = on_dead
+        self.abort = abort
+        self.exit_code = int(exit_code)
+        self.exclude = set(exclude_pids)
+        self.exclude.add(os.getpid())
+        self.dead = []
+        self._reported = set()
+        self._stop = threading.Event()
+        self._thread = None
+        self._t0 = time.time()
+
+    def start(self):
+        self._t0 = time.time()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name='nbkit-fleet-monitor')
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check_once()
+            except Exception:   # monitoring must never kill a healthy run
+                pass
+
+    def _emit(self, name, attrs):
+        """A detection event must survive even when the tracer is
+        already gone — a dead peer usually errors the main thread's
+        collective FIRST, and by the time the heartbeat gap confirms
+        the death the interpreter may be deep in teardown (tracer
+        closed by atexit, main thread blocked in the distributed
+        runtime's shutdown).  Fall back to appending the span record
+        directly into the watched directory, where the post-mortem
+        analyzer merges it like any per-process trace file."""
+        tr = current_tracer()
+        if tr is not None:
+            try:
+                f = getattr(tr, '_f', None)
+                if f is not None and not f.closed:
+                    tr.event(name, attrs)
+                    return
+            except Exception:
+                pass
+        if not os.path.isdir(self.path):
+            return
+        rec = {'t': 'span', 'name': name, 'ts': round(time.time(), 6),
+               'dur': 0.0, 'depth': 0, 'pid': os.getpid(), 'ok': True,
+               'attrs': attrs}
+        try:
+            with open(os.path.join(
+                    self.path, 'monitor-%d.jsonl' % os.getpid()),
+                    'a') as f:
+                f.write(json.dumps(rec) + '\n')
+                f.flush()
+        except OSError:
+            pass
+
+    def check_once(self, now=None):
+        """One scan; declares (and with ``abort``, acts on) fresh
+        deaths.  Split out for tests.  Returns the scan entries."""
+        now = time.time() if now is None else now
+        entries = scan_liveness(self.path, gap_s=self.gap_s, now=now,
+                                exclude_pids=self.exclude)
+        fresh = []
+        for e in entries:
+            if not e['dead'] or e['pid'] in self._reported:
+                continue
+            if e['last_seen'] is not None and \
+                    e['last_seen'] < self._t0 - self.gap_s:
+                continue        # died before our watch began
+            self._reported.add(e['pid'])
+            self.dead.append(e)
+            fresh.append(e)
+            counter('resilience.fleet.dead_ranks').add(1)
+            self._emit('resilience.fleet.dead_rank',
+                       {'pid': e['pid'], 'rank': e['rank'],
+                        'gap_s': e['gap_s']})
+            if self.on_dead is not None:
+                try:
+                    self.on_dead(e)
+                except Exception:
+                    pass
+        if fresh and self.abort:
+            self._abort(fresh)
+        return entries
+
+    def _abort(self, entries):
+        self._emit('resilience.fleet.abort',
+                   {'pids': [e['pid'] for e in entries],
+                    'exit_code': self.exit_code})
+        tr = current_tracer()
+        if tr is not None:
+            try:
+                tr.close()
+            except Exception:
+                pass
+        os._exit(self.exit_code)

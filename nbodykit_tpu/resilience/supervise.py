@@ -289,6 +289,13 @@ class Supervisor(object):
                 if kind in (TRANSIENT, DEADLINE):
                     if retries >= self.policy.max_retries:
                         raise
+                    from .fleet import preemption_requested
+                    if preemption_requested():
+                        # a SIGTERM'd process must spend its grace
+                        # budget sealing a checkpoint, not sleeping in
+                        # backoff — surface the error and let the safe
+                        # point raise Preempted
+                        raise
                     delay = self.policy.backoff_s(retries)
                     retries += 1
                     self._event('retries', attempt=retries,

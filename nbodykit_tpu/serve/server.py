@@ -232,6 +232,41 @@ class AnalysisServer(object):
         for t in self._threads:
             t.join(timeout=5.0)
 
+    def preempt(self, grace_s=5.0):
+        """Preemption drain: the SIGTERM response for a serving
+        process (docs/RESILIENCE.md).  Stops accepting, EVICTS every
+        queued ticket immediately with a structured ``preempted``
+        verdict (inflight work is worth the grace budget; queued work
+        is not — the client retries elsewhere), drains inflight
+        requests for up to ``grace_s``, then stops the workers.  Every
+        submitted request still ends with a verdict — zero lost.
+        Returns ``{'evicted': n, 'drained': bool}``."""
+        counter('serve.preempted').add(1)
+        from ..diagnostics import current_tracer
+        tr = current_tracer()
+        if tr is not None:
+            tr.event('resilience.preempted', {'where': 'serve'})
+        with self._cv:
+            self._accepting = False
+            evicted = list(self._pending)
+            self._pending = []
+            gauge('serve.queue_depth').set(0)
+            for t in evicted:
+                self._finish(t, RequestResult(
+                    t.request.request_id, EVICTED,
+                    reason={'code': 'preempted',
+                            'detail': 'server preempted before run'},
+                    algorithm=t.request.algorithm,
+                    shape_class=t.request.shape_class))
+            self._cv.notify_all()
+        drained = self.drain(timeout=grace_s)
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        return {'evicted': len(evicted), 'drained': drained}
+
     # -- submission -------------------------------------------------------
 
     def submit(self, request):
@@ -246,6 +281,11 @@ class AnalysisServer(object):
             depth = len(self._pending)
         aff = affinity(request, self.ndevices, len(self.meshes))
         if not accepting:
+            from ..resilience.fleet import preemption_requested
+            if preemption_requested():
+                return self._reject_now(request, now, {
+                    'code': 'preempted',
+                    'detail': 'server preempted; retry elsewhere'})
             return self._reject_now(request, now, {
                 'code': 'shutting_down',
                 'detail': 'server no longer accepting requests'})
@@ -501,6 +541,9 @@ class AnalysisServer(object):
                        if r.event_count('degradations'))
         resumed = sum(1 for r in results if r.event_count('resumes'))
         admit_deg = sum(1 for r in results if r.admit_options)
+        preempted = sum(
+            1 for r in results
+            if (r.reason or {}).get('code') == 'preempted')
         return {
             'submitted': submitted,
             'resolved': len(results),
@@ -513,6 +556,7 @@ class AnalysisServer(object):
             'fault_degraded': degraded,
             'resumed': resumed,
             'admit_degraded': admit_deg,
+            'preempted': preempted,
             'p50_s': self._pctile(lat, 0.50),
             'p99_s': self._pctile(lat, 0.99),
             'mean_s': sum(lat) / len(lat) if lat else None,

@@ -225,11 +225,61 @@ print('serve gate OK: %(completed)d/%(submitted)d completed, '
       'retried=%(retried)d lost=%(lost)d p99=%(p99_s).3fs' % rec)
 EOF
 
+# fleet survivability gate (docs/RESILIENCE.md): a 2-process gloo
+# fleet has rank 1 SIGKILLed entering rep 2 — rank 0's live monitor
+# must detect the dead peer and exit DEAD_RANK_EXIT (76) instead of
+# wedging in the collective, leaving a sealed 2-rank manifest; the
+# 1-process relaunch re-forms the mesh, repartitions the surviving
+# shards and resumes from the seal (reformed_from: 2)
+echo "== fleet kill/detect/re-form/resume gate (2 proc -> 1) =="
+fleet_env=(env JAX_PLATFORMS=cpu
+           NBKIT_DIAGNOSTICS="$SMOKE_TMP/FLEET_TRACE"
+           NBKIT_DIAGNOSTICS_HEARTBEAT=0.25
+           NBKIT_FLEET_DIR="$SMOKE_TMP/FLEET_CKPT"
+           NBKIT_FLEET_RECORD="$SMOKE_TMP/fleet_rec.json"
+           NBKIT_FLEET_GAP_S=1.5)
+mkdir -p "$SMOKE_TMP/FLEET_CKPT"
+rc0=0; rc1=0
+"${fleet_env[@]}" NBKIT_FAULTS='rank1@bench.rep@2:sigkill' \
+    python tests/_multihost_worker.py 127.0.0.1:12377 2 0 fleet \
+    > "$SMOKE_TMP/fleet0.log" 2>&1 &
+pid0=$!
+"${fleet_env[@]}" NBKIT_FAULTS='rank1@bench.rep@2:sigkill' \
+    python tests/_multihost_worker.py 127.0.0.1:12377 2 1 fleet \
+    > "$SMOKE_TMP/fleet1.log" 2>&1 &
+pid1=$!
+wait "$pid0" || rc0=$?
+wait "$pid1" || rc1=$?
+[ "$rc0" -eq 76 ] || { echo "rank 0: expected DEAD_RANK_EXIT (76)," \
+    "got rc=$rc0"; tail -40 "$SMOKE_TMP/fleet0.log"; exit 1; }
+[ "$rc1" -eq 137 ] || { echo "rank 1: expected SIGKILL (137), got" \
+    "rc=$rc1"; tail -40 "$SMOKE_TMP/fleet1.log"; exit 1; }
+"${fleet_env[@]}" python tests/_multihost_worker.py none 1 0 fleet \
+    > "$SMOKE_TMP/fleet_resume.log" 2>&1 \
+    || { tail -40 "$SMOKE_TMP/fleet_resume.log"; exit 1; }
+python - "$SMOKE_TMP" <<'EOF'
+import json, os, sys
+tmp = sys.argv[1]
+rec = json.load(open(os.path.join(tmp, 'fleet_rec.json')))
+assert rec.get('resumed') is True, rec
+assert rec.get('reformed_from') == 2 and rec.get('reformed_to') == 1, rec
+assert rec.get('completed') == rec.get('reps'), rec
+from nbodykit_tpu.diagnostics import read_trace
+records, _ = read_trace(os.path.join(tmp, 'FLEET_TRACE'))
+dead = [r for r in records if r.get('t') == 'span'
+        and r.get('name') == 'resilience.fleet.dead_rank']
+assert dead, 'no dead-rank event in the monitor trace'
+print('fleet gate OK: dead rank detected, mesh re-formed '
+      '%(reformed_from)d -> %(reformed_to)d, resumed at rep '
+      '%(resumed_reps)d' % rec)
+EOF
+
 echo "== tier-1 fast subset =="
 python -m pytest \
     tests/test_diagnostics.py \
     tests/test_diagnostics_analyze.py \
     tests/test_resilience.py \
+    tests/test_fleet.py \
     tests/test_tune.py \
     tests/test_serve.py \
     tests/test_lint.py \

@@ -82,6 +82,12 @@ def _barrier(mesh, tag):
 def main():
     coord, nprocs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else 'pipeline'
+    if mode == 'fleet':
+        # stamp the fleet coordinates into the environment FIRST: the
+        # chaos matrix's rank-scoped fault rules (resilience.faults)
+        # and the tracer's heartbeat rank field both read these
+        os.environ['NBKIT_FLEET_RANK'] = str(pid)
+        os.environ['NBKIT_FLEET_SIZE'] = str(nprocs)
     from nbodykit_tpu.parallel.runtime import init_distributed, \
         world_mesh
     if nprocs > 1:
@@ -99,6 +105,8 @@ def main():
                                 num_processes=nprocs, process_id=pid)
     if mode == 'batch':
         return main_batch()
+    if mode == 'fleet':
+        return main_fleet(nprocs, pid)
 
     def pipeline():
         with diagnostics.span('multihost.pipeline', nprocs=nprocs,
@@ -166,6 +174,143 @@ def main_batch():
         with TaskManager(cpus_per_task=4) as tm:
             results = tm.map(work, list(range(11, 16)))
     print("BATCHRESULT %s" % ",".join("%.3f" % r for r in results),
+          flush=True)
+
+
+def main_fleet(nprocs, pid):
+    """Fleet-survivability pipeline: a checkpointed rep loop under the
+    full resilience stack (nbodykit_tpu.resilience.fleet,
+    docs/RESILIENCE.md).  Every rep paints a deterministic particle
+    set into an accumulating density field and commits a coordinated
+    checkpoint — per-rank shards sealed by a rank-0 manifest after a
+    digest allgather.  A relaunch resumes from the newest SEALED
+    manifest; a relaunch with fewer processes re-forms the mesh and
+    repartitions the surviving shards (shrink-to-survive).  The chaos
+    matrix drives it via ``$NBKIT_FAULTS`` rank-scoped rules
+    (``rank1@bench.rep@2:sigkill``), and a live :class:`FleetMonitor`
+    on every rank turns a dead peer into a prompt DEAD_RANK_EXIT
+    instead of a wedged collective.
+
+    Env contract: ``NBKIT_FLEET_DIR`` (checkpoint root, required),
+    ``NBKIT_FLEET_RECORD`` (rank-0 record JSON path),
+    ``NBKIT_FLEET_REPS`` (default 4), ``NBKIT_FLEET_GAP_S`` (detector
+    threshold, default 1.5), ``NBKIT_FLEET_GRACE_S`` (preemption
+    budget, default 10).  Prints ``FLEETRESULT ndev completed total
+    p2`` on success."""
+    import json
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from nbodykit_tpu.parallel.runtime import AXIS, world_mesh
+    from nbodykit_tpu.pmesh import ParticleMesh
+    from nbodykit_tpu.resilience import (PREEMPTED_EXIT,
+                                         FleetCheckpointStore,
+                                         FleetMonitor, Preempted,
+                                         check_preemption, fault_point,
+                                         install_preemption_handler)
+
+    root = os.environ['NBKIT_FLEET_DIR']
+    record_path = os.environ.get('NBKIT_FLEET_RECORD', '')
+    reps = int(os.environ.get('NBKIT_FLEET_REPS', '4') or 4)
+    gap_s = float(os.environ.get('NBKIT_FLEET_GAP_S', '1.5') or 1.5)
+    grace_s = float(os.environ.get('NBKIT_FLEET_GRACE_S', '10') or 10)
+    install_preemption_handler(grace_s=grace_s)
+
+    mesh = world_mesh()
+    ndev = len(jax.devices())
+    Nmesh = 16
+    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=50.0, dtype='f4', comm=mesh)
+    store = FleetCheckpointStore(root)
+    key = 'fleet.pipeline'
+    sharding = NamedSharding(mesh, P(AXIS, None))
+
+    rec = {'nranks': nprocs, 'ndev': ndev, 'reps': reps}
+
+    # resume: this rank's slice of the newest SEALED manifest.  A
+    # different rank count than the manifest's is the shrink path —
+    # load() repartitions the surviving shards and info carries the
+    # re-formation stamps
+    start, block = 0, None
+    got = store.load(key, rank=pid, nranks=nprocs)
+    if got is not None:
+        state, arrays, info = got
+        start = int(state['completed'])
+        block = arrays['field']
+        rec['resumed'] = True
+        rec['resumed_reps'] = start
+        if info.get('reformed'):
+            from nbodykit_tpu.parallel.runtime import \
+                reform_decomposition
+            rec.update(reform_decomposition(info['reformed_from'],
+                                            info['reformed_to'],
+                                            ndev_per_rank=4))
+
+    # the accumulated field as a distributed array: row offset of this
+    # rank's block is rank * (rows / nranks) — make_array only asks
+    # the callback for this process's addressable slices, all of which
+    # land inside the block
+    full = np.zeros((Nmesh, Nmesh, Nmesh), 'f4')
+    if block is not None:
+        off = pid * (Nmesh // nprocs)
+        full[off:off + block.shape[0]] = block
+    field = jax.make_array_from_callback(
+        (Nmesh, Nmesh, Nmesh), sharding, lambda idx: full[idx])
+
+    def local_block(arr):
+        """This process's contiguous slab rows, for the shard file."""
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards],
+                              axis=0)
+
+    monitor = None
+    if nprocs > 1 and diagnostics.current_tracer() is not None:
+        monitor = FleetMonitor(diagnostics.current_tracer().dir,
+                               gap_s=gap_s, abort=True)
+        monitor.start()
+
+    N = 2048
+    try:
+        with diagnostics.span('fleet.pipeline', nprocs=nprocs,
+                              proc=pid, start=start):
+            if nprocs > 1:
+                _barrier(mesh, 'fleet.start')
+            for r in range(start, reps):
+                fault_point('bench.rep')
+                check_preemption('fleet.rep%d' % r)
+                pos_np = np.random.RandomState(100 + r).uniform(
+                    0, 50.0, (N, 3)).astype('f4')
+                pos = jax.make_array_from_callback(
+                    (N, 3), NamedSharding(mesh, P(AXIS, None)),
+                    lambda idx: pos_np[idx])
+                with diagnostics.span('fleet.rep', rep=r):
+                    field = field + pm.paint(pos, 1.0, resampler='cic')
+                    field.block_until_ready()
+                store.save(key, {'completed': r + 1, 'reps': reps},
+                           arrays={'field': local_block(field)},
+                           mesh=mesh if nprocs > 1 else None,
+                           seq=r + 1, rank=pid, nranks=nprocs)
+            total = float(jnp.sum(field.astype(jnp.float32)))
+            c = pm.r2c(field)
+            p2 = float(jnp.sum(jnp.abs(c) ** 2))
+            if nprocs > 1:
+                _barrier(mesh, 'fleet.end')
+    except Preempted:
+        rec['preempted'] = True
+        rec['completed'] = store.latest_manifest(key) or {}
+        rec['completed'] = int(rec['completed'].get('seq', start))
+        if pid == 0 and record_path:
+            diagnostics.atomic_write(record_path, json.dumps(rec))
+        if monitor is not None:
+            monitor.stop()
+        sys.exit(PREEMPTED_EXIT)
+    if monitor is not None:
+        monitor.stop()
+
+    rec.update(completed=reps, total=round(total, 3),
+               p2='%.6e' % p2)
+    if pid == 0 and record_path:
+        diagnostics.atomic_write(record_path, json.dumps(rec))
+    print("FLEETRESULT %d %d %.6e %.6e" % (ndev, reps, total, p2),
           flush=True)
 
 

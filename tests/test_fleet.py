@@ -1,0 +1,580 @@
+"""Tests for nbodykit_tpu.resilience.fleet — fleet survivability:
+coordinated manifest-sealed checkpoints (all-or-nothing under injected
+kills), the rank-scoped chaos-matrix fault grammar, SIGTERM preemption
+inside a grace budget, the live heartbeat failure detector, and
+shrink-to-survive shard repartitioning (8-rank state resumed on 4
+ranks reproduces the FFTPower bit-for-bit).  The slow 2-process test
+drives the full kill -> detect -> re-form -> resume choreography over
+real gloo collectives."""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import nbodykit_tpu
+from nbodykit_tpu import _global_options, diagnostics
+from nbodykit_tpu.diagnostics import REGISTRY, read_trace
+from nbodykit_tpu.resilience import (DEAD_RANK_EXIT, PREEMPTED_EXIT,
+                                     FleetCheckpointStore, FleetMonitor,
+                                     CheckpointStore, Preempted,
+                                     check_preemption, clear_preemption,
+                                     fault_point,
+                                     install_preemption_handler,
+                                     parse_spec, preemption_requested,
+                                     reassemble, repartition,
+                                     reset_faults, scan_liveness,
+                                     uninstall_preemption_handler)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      '_multihost_worker.py')
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    """Registry, tracer, fault counts, preemption state and the fleet
+    rank env are process-wide; every test sees (and leaves) a pristine
+    copy."""
+    saved = _global_options.copy()
+    monkeypatch.delenv('NBKIT_FLEET_RANK', raising=False)
+    monkeypatch.delenv('NBKIT_FLEET_SIZE', raising=False)
+    REGISTRY.reset()
+    reset_faults()
+    clear_preemption()
+    yield
+    uninstall_preemption_handler()
+    clear_preemption()
+    REGISTRY.reset()
+    reset_faults()
+    diagnostics.configure(None)
+    _global_options.clear()
+    _global_options.update(saved)
+
+
+def _counter(name):
+    snap = REGISTRY.snapshot().get(name)
+    return snap['value'] if snap else 0
+
+
+# ---------------------------------------------------------------------------
+# chaos-matrix fault grammar
+
+def test_parse_spec_rank_scoped_rules():
+    got = parse_spec('rank1@bench.rep:sigkill,'
+                     'rank0@ckpt.manifest@2:sigterm,'
+                     'bench.rep@2:kill')
+    # rank-less rules keep their 3-tuple shape (back compat); rank-
+    # scoped rules carry the rank as a 4th element
+    assert got == [('bench.rep', 1, 'sigkill', 1),
+                   ('ckpt.manifest', 2, 'sigterm', 0),
+                   ('bench.rep', 2, 'kill')]
+    with pytest.raises(ValueError):
+        parse_spec('rank1@p@2:explode')
+
+
+def test_rank_scoped_fault_fires_only_on_matching_rank(monkeypatch):
+    """All ranks COUNT the targeted point (rank-uniform bookkeeping);
+    only the matching rank acts — the collective sequence on survivors
+    never branches."""
+    monkeypatch.setenv('NBKIT_FLEET_RANK', '0')
+    with nbodykit_tpu.set_options(faults='rank1@p@1:unavailable'):
+        reset_faults()
+        fault_point('p')                     # rank 0: counted, no fire
+    monkeypatch.setenv('NBKIT_FLEET_RANK', '1')
+    with nbodykit_tpu.set_options(faults='rank1@p@1:unavailable'):
+        reset_faults()
+        with pytest.raises(Exception, match='UNAVAILABLE'):
+            fault_point('p')
+
+
+def test_sigterm_fault_requests_preemption():
+    """The ``sigterm`` action delivers a real SIGTERM to this process
+    and RETURNS — the run continues to its next safe point, which
+    raises :class:`Preempted` with grace still on the clock."""
+    install_preemption_handler(grace_s=60.0)
+    assert not preemption_requested()
+    with nbodykit_tpu.set_options(faults='p@1:sigterm'):
+        reset_faults()
+        fault_point('p')                     # delivers + returns
+    deadline = time.time() + 5.0
+    while not preemption_requested() and time.time() < deadline:
+        time.sleep(0.01)                     # handler is async-deferred
+    assert preemption_requested()
+    with pytest.raises(Preempted, match='grace left'):
+        check_preemption('test.safe.point')
+    assert _counter('resilience.preempted') == 1
+
+
+# ---------------------------------------------------------------------------
+# coordinated checkpoints: shard + manifest seal
+
+def _save_ranked(store, key, seq, nranks, full):
+    """Commit ``full`` split into ``nranks`` slabs as one sealed seq."""
+    blocks = np.array_split(full, nranks, axis=0)
+    for r in range(nranks):
+        store.save_shard(key, seq, r, nranks,
+                         {'completed': seq}, arrays={'f': blocks[r]})
+    store.seal(key, seq, nranks=nranks, rank=0)
+
+
+def test_fleet_save_seal_load_roundtrip(tmp_path):
+    store = FleetCheckpointStore(tmp_path)
+    full = np.arange(16.0 * 4 * 4, dtype='f4').reshape(16, 4, 4)
+    _save_ranked(store, 'k', 1, 4, full)
+    man = store.latest_manifest('k')
+    assert man['seq'] == 1 and man['nranks'] == 4
+    assert len(man['shards']) == 4
+    # same rank count: the shard exactly as saved, no re-formation
+    state, arrays, info = store.load('k', rank=2, nranks=4)
+    assert state == {'completed': 1}
+    np.testing.assert_array_equal(arrays['f'], full[8:12])
+    assert info == {'seq': 1, 'nranks': 4, 'reformed': False}
+    # full reassembly matches the original
+    state, arrays, man2 = store.load_full('k')
+    np.testing.assert_array_equal(arrays['f'], full)
+    assert man2['seq'] == 1
+
+
+def test_shrink_repartition_fftpower_equivalence(tmp_path):
+    """ISSUE acceptance: an 8-rank sealed checkpoint resumed on 4
+    ranks reassembles the identical field — the FFT power spectrum of
+    the re-formed mesh matches the original bit-for-bit."""
+    store = FleetCheckpointStore(tmp_path)
+    rng = np.random.RandomState(42)
+    full = rng.uniform(size=(16, 16, 16)).astype('f4')
+    _save_ranked(store, 'fleet.k', 1, 8, full)
+    parts = []
+    for r in range(4):
+        state, arrays, info = store.load('fleet.k', rank=r, nranks=4)
+        assert info['reformed'] is True
+        assert info['reformed_from'] == 8 and info['reformed_to'] == 4
+        parts.append(arrays['f'])
+    rebuilt = reassemble([{'f': p} for p in parts])['f']
+    np.testing.assert_array_equal(rebuilt, full)
+    # P(k) proxy: binned |FFT|^2 must agree exactly
+    def power(field):
+        c = np.fft.rfftn(field)
+        return np.abs(c) ** 2
+    np.testing.assert_array_equal(power(rebuilt), power(full))
+    assert _counter('resilience.fleet.reformed') == 4
+
+
+def test_repartition_uneven_and_identity():
+    blocks = [np.arange(6.0).reshape(3, 2), np.arange(4.0).reshape(2, 2)]
+    full = np.concatenate(blocks, axis=0)
+    again = repartition([{'x': b} for b in blocks], 2)
+    np.testing.assert_array_equal(
+        np.concatenate([p['x'] for p in again], axis=0), full)
+    solo = repartition([{'x': b} for b in blocks], 1)
+    np.testing.assert_array_equal(solo[0]['x'], full)
+
+
+def test_manifest_seal_atomic_under_sigkill(tmp_path):
+    """A SIGKILL between shard commit and manifest seal (injected at
+    the pre-rename ``ckpt.manifest`` fault point) leaves the PREVIOUS
+    sealed manifest authoritative — all-or-nothing."""
+    script = r"""
+import os, sys
+import numpy as np
+sys.path.insert(0, %r)
+import nbodykit_tpu
+from nbodykit_tpu.resilience import FleetCheckpointStore
+# the SECOND manifest write dies between the tmp write and the rename
+nbodykit_tpu.set_options(faults='ckpt.manifest@2:kill')
+st = FleetCheckpointStore(%r)
+for seq in (1, 2):
+    st.save_shard('k', seq, 0, 1, {'completed': seq},
+                  arrays={'f': np.full(4, seq, 'f4')})
+    st.seal('k', seq, nranks=1, rank=0)   # seq 2: SIGKILLed mid-seal
+raise SystemExit('unreachable')
+""" % (REPO, str(tmp_path))
+    proc = subprocess.run([sys.executable, '-c', script],
+                          capture_output=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    store = FleetCheckpointStore(tmp_path)
+    man = store.latest_manifest('k')
+    assert man is not None and man['seq'] == 1, \
+        'previous sealed manifest lost to a mid-seal kill'
+    state, arrays, _ = store.load_full('k')
+    np.testing.assert_array_equal(arrays['f'], np.full(4, 1, 'f4'))
+    sv = store.survey()
+    assert sv['sealed'] == 1
+    # seq 2's shards are visible as INCOMPLETE (kill debris), and a
+    # relaunch never reuses the torn seq
+    assert sv['families']['k']['incomplete'] == [2]
+    assert store.next_seq('k') == 3
+
+
+def test_seal_refuses_missing_shard(tmp_path):
+    from nbodykit_tpu.resilience import FleetSealError
+    store = FleetCheckpointStore(tmp_path)
+    store.save_shard('k', 1, 0, 2, {'completed': 1},
+                     arrays={'f': np.ones(2, 'f4')})
+    # rank 1's shard never landed: the seal must refuse on every rank
+    with pytest.raises(FleetSealError, match='rank 1'):
+        store.seal('k', 1, nranks=2, rank=0)
+    assert store.latest_manifest('k') is None
+    assert _counter('resilience.fleet.seal_failed') == 1
+
+
+# ---------------------------------------------------------------------------
+# retention
+
+def test_checkpoint_store_gc_tmp(tmp_path):
+    st = CheckpointStore(tmp_path)
+    st.save('k', {'completed': 1})
+    orphan = os.path.join(tmp_path, 'k.ckpt.json.tmp.999')
+    with open(orphan, 'w') as f:
+        f.write('{"torn":')
+    old = time.time() - 7200
+    os.utime(orphan, (old, old))
+    assert st.orphan_tmp(max_age_s=3600) == [orphan]
+    assert st.gc_tmp(max_age_s=3600) == 1
+    assert not os.path.exists(orphan)
+    assert st.load('k') is not None          # real checkpoint untouched
+
+
+def test_fleet_gc_keeps_last_k_and_drops_debris(tmp_path):
+    store = FleetCheckpointStore(tmp_path, keep=2)
+    full = np.arange(8.0, dtype='f4').reshape(4, 2)
+    for seq in (1, 2, 3, 4):
+        _save_ranked(store, 'k', seq, 2, full * seq)
+    # unsealed debris OLDER than the newest seal (a torn seq a later
+    # relaunch already superseded)
+    store.save_shard('k', 3, 1, 2, {'junk': True},
+                     arrays={'f': full[:2]})
+    os.remove(os.path.join(tmp_path, 'k.m0003.manifest.json'))
+    removed = store.gc()
+    assert removed['manifests'] >= 1         # seqs 1 (and torn 3) gone
+    sv = store.survey()
+    assert sv['families']['k']['sealed'] == [2, 4]
+    assert sv['families']['k']['incomplete'] == []
+    # the newest sealed seq still loads in full
+    state, arrays, man = store.load_full('k')
+    assert man['seq'] == 4
+    np.testing.assert_array_equal(arrays['f'], full * 4)
+
+
+# ---------------------------------------------------------------------------
+# live failure detection
+
+def _write_trace(dirpath, pid, beats, iv=0.25, rank=None,
+                 preempted_at=None):
+    """A synthetic per-process trace file: meta + hb records (+ an
+    optional clean preemption announcement)."""
+    os.makedirs(dirpath, exist_ok=True)
+    recs = [{'t': 'meta', 'version': 1, 'pid': pid, 'ts': beats[0],
+             'heartbeat_s': iv,
+             **({'rank': rank} if rank is not None else {})}]
+    for ts in beats:
+        recs.append({'t': 'hb', 'pid': pid, 'ts': ts, 'iv': iv,
+                     **({'rank': rank} if rank is not None else {})})
+    if preempted_at is not None:
+        recs.append({'t': 'span', 'name': 'resilience.preempted',
+                     'pid': pid, 'ts': preempted_at, 'dur': 0.0,
+                     'depth': 0})
+    with open(os.path.join(dirpath, 'trace-%d.jsonl' % pid), 'w') as f:
+        for r in recs:
+            f.write(json.dumps(r) + '\n')
+
+
+def test_scan_liveness_thresholds(tmp_path):
+    t0 = 1000.0
+    d = str(tmp_path)
+    # rank 0: beating until "now" — alive
+    _write_trace(d, 101, [t0 + 0.25 * i for i in range(40)], rank=0)
+    # rank 1: stopped 5 s ago — dead at any sane threshold
+    _write_trace(d, 102, [t0 + 0.25 * i for i in range(20)], rank=1)
+    # rank 2: stopped, but announced a clean preemption — never dead
+    _write_trace(d, 103, [t0 + 0.25 * i for i in range(12)], rank=2,
+                 preempted_at=t0 + 3.0)
+    # rank 3: no heartbeats at all — no liveness claim
+    _write_trace(d, 104, [t0], iv=0)
+    now = t0 + 10.0
+    by_pid = {e['pid']: e for e in scan_liveness(d, gap_s=1.5, now=now)}
+    assert by_pid[101]['dead'] is False
+    assert by_pid[102]['dead'] is True
+    assert by_pid[102]['rank'] == 1
+    assert by_pid[102]['gap_s'] == pytest.approx(10.0 - 4.75)
+    assert by_pid[103]['dead'] is False
+    assert by_pid[103]['preempted'] is True
+    assert by_pid[104]['dead'] is None
+    # below the threshold nobody is dead
+    by_pid = {e['pid']: e
+              for e in scan_liveness(d, gap_s=1.5, now=t0 + 5.5)}
+    assert by_pid[102]['dead'] is False
+    # default threshold = max(3*iv, 2 s)
+    by_pid = {e['pid']: e for e in scan_liveness(d, now=now)}
+    assert by_pid[102]['dead'] is True
+
+
+def test_fleet_monitor_declares_once_and_calls_back(tmp_path):
+    t0 = time.time()
+    d = str(tmp_path)
+    _write_trace(d, 201, [t0 - 5.0 + 0.25 * i for i in range(12)],
+                 rank=1)
+    deaths = []
+    mon = FleetMonitor(d, gap_s=1.5, on_dead=deaths.append)
+    mon._t0 = t0 - 10.0                       # rank died on our watch
+    entries = mon.check_once(now=t0)
+    assert [e['pid'] for e in mon.dead] == [201]
+    assert deaths[0]['rank'] == 1
+    assert _counter('resilience.fleet.dead_ranks') == 1
+    # a second scan does not re-declare
+    mon.check_once(now=t0 + 1.0)
+    assert len(mon.dead) == 1
+    assert any(e['pid'] == 201 for e in entries)
+
+
+def test_fleet_monitor_ignores_stale_traces(tmp_path):
+    """A trace file from an earlier incarnation (last record already
+    older than start - gap when the monitor began) must not be
+    declared — only deaths on this monitor's watch count."""
+    t0 = time.time()
+    d = str(tmp_path)
+    _write_trace(d, 301, [t0 - 60.0 + 0.25 * i for i in range(4)])
+    mon = FleetMonitor(d, gap_s=1.5)
+    mon._t0 = t0                              # watch starts NOW
+    mon.check_once(now=t0 + 2.0)
+    assert mon.dead == []
+    assert _counter('resilience.fleet.dead_ranks') == 0
+
+
+# ---------------------------------------------------------------------------
+# preempted-vs-silent in the post-mortem analyzer
+
+def test_analyze_distinguishes_preempted_from_silent(tmp_path):
+    from nbodykit_tpu.diagnostics.analyze import (heartbeat_report,
+                                                  load_processes)
+    t0 = 2000.0
+    d = str(tmp_path)
+    # pid 1: beats the whole window (defines the trace end)
+    _write_trace(d, 1, [t0 + 0.25 * i for i in range(80)])
+    # pid 2: silent death — heartbeats stop, no announcement
+    _write_trace(d, 2, [t0 + 0.25 * i for i in range(10)])
+    # pid 3: preempted — same gap, but announced cleanly
+    _write_trace(d, 3, [t0 + 0.25 * i for i in range(10)],
+                 preempted_at=t0 + 2.5)
+    procs, _ = load_processes(d)
+    hb = heartbeat_report(procs, {})
+    assert hb['2']['silent'] is True and hb['2']['preempted'] is False
+    assert hb['3']['silent'] is False and hb['3']['preempted'] is True
+    from nbodykit_tpu.diagnostics.analyze import render_analysis, analyze
+    text = render_analysis(analyze(d))
+    assert 'PREEMPTED' in text
+    assert re.search(r'SILENT.*\n.*\b2\b', text)
+
+
+# ---------------------------------------------------------------------------
+# serve: preemption drain with zero lost requests
+
+def test_serve_preempt_drains_with_zero_lost():
+    from nbodykit_tpu.parallel.runtime import cpu_mesh, use_mesh
+    from nbodykit_tpu.resilience import fleet
+    from nbodykit_tpu.serve import AnalysisRequest, AnalysisServer
+    with use_mesh(cpu_mesh(1)):
+        srv = AnalysisServer(per_task=1)
+    tickets = [srv.submit(AnalysisRequest(nmesh=16, npart=500, seed=s))
+               for s in range(3)]
+    out = srv.preempt(grace_s=30.0)
+    assert out['drained'] is True
+    results = [srv.wait(t, timeout=5.0) for t in tickets]
+    assert all(r is not None for r in results)
+    summ = srv.summary()
+    assert summ['lost'] == 0
+    # every preemption eviction carries the structured verdict
+    assert summ['preempted'] == sum(
+        1 for r in results
+        if (r.reason or {}).get('code') == 'preempted')
+    # a submit AFTER the preemption notice is rejected as preempted,
+    # not as a generic shutdown
+    fleet._preempt['requested_at'] = time.time()
+    try:
+        late = srv.wait(srv.submit(
+            AnalysisRequest(nmesh=16, npart=500, seed=9)), timeout=5.0)
+    finally:
+        clear_preemption()
+    assert late.status == 'rejected'
+    assert late.reason['code'] == 'preempted'
+    srv.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# regress / doctor posture
+
+def test_fleet_summary_counts_posture(tmp_path):
+    from nbodykit_tpu.diagnostics.regress import fleet_summary
+    out = fleet_summary(str(tmp_path))
+    assert out['preempted_records'] == 0 and out['reformations'] == []
+    with open(tmp_path / 'BENCH_STAGED.json', 'w') as f:
+        json.dump({'results': {
+            'a': {'metric': 'a', 'preempted': True},
+            'b': {'metric': 'b', 'reformed_from': 8,
+                  'reformed_to': 4}}}, f)
+    store = FleetCheckpointStore(tmp_path / 'BENCH_CKPT')
+    full = np.ones((4, 2), 'f4')
+    _save_ranked(store, 'k', 1, 2, full)
+    store.save_shard('k', 2, 0, 2, {'x': 1}, arrays={'f': full[:2]})
+    out = fleet_summary(str(tmp_path))
+    assert out['preempted_records'] == 1
+    assert out['reformed_records'] == 1
+    assert out['reformations'][0]['reformed_from'] == 8
+    assert out['sealed_manifests'] == 1
+    assert out['incomplete_seqs'] == 1
+
+
+def test_doctor_fleet_line_warns_on_incomplete(tmp_path):
+    import io
+    from nbodykit_tpu.diagnostics.__main__ import run_doctor
+    store = FleetCheckpointStore(tmp_path / 'BENCH_CKPT')
+    store.save_shard('k', 1, 0, 2, {'x': 1},
+                     arrays={'f': np.ones(2, 'f4')})
+    buf = io.StringIO()
+    run_doctor(trace=None, root=str(tmp_path), out=buf,
+               self_check_only=False)
+    text = buf.getvalue()
+    assert 'fleet        WARN' in text
+    assert 'INCOMPLETE manifest' in text
+
+
+def test_reform_decomposition_stamps():
+    from nbodykit_tpu.parallel.runtime import reform_decomposition
+    got = reform_decomposition(2, 1, ndev_per_rank=4)
+    assert got['reformed_from'] == 2 and got['reformed_to'] == 1
+    assert got['pencil_from'] == [2, 4] and got['pencil_to'] == [2, 2]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: bench preempted by SIGTERM resumes with zero recomputed
+# reps
+
+def test_bench_preempt_then_resume_zero_recompute(tmp_path):
+    """bench.py --config under ``bench.rep@2:sigterm``: the injected
+    preemption notice lands entering rep 2; rep 1 is already sealed,
+    so the run exits PREEMPTED_EXIT with a ``preempted`` staged record
+    — and the relaunch resumes at rep 2 exactly (zero recomputed
+    reps)."""
+    env_base = dict(
+        os.environ,
+        JAX_PLATFORMS='cpu',
+        BENCH_REPS='2', BENCH_PHASES='0',
+        BENCH_PREEMPT_GRACE_S='60',
+        BENCH_STAGED_PATH=str(tmp_path / 'STAGED.json'),
+        BENCH_DETAIL_PATH=str(tmp_path / 'DETAIL.json'),
+        BENCH_CKPT_DIR=str(tmp_path / 'CKPT'),
+        BENCH_TRACE_DIR=str(tmp_path / 'TRACE'),
+    )
+    env_base.pop('NBKIT_FAULTS', None)
+    bench = os.path.join(REPO, 'bench.py')
+    env1 = dict(env_base, NBKIT_FAULTS='bench.rep@2:sigterm')
+    p1 = subprocess.run([sys.executable, bench, '--config', '32',
+                         '2000'], capture_output=True, timeout=560,
+                        env=env1)
+    assert p1.returncode == PREEMPTED_EXIT, p1.stderr.decode()[-2000:]
+    staged = json.load(open(tmp_path / 'STAGED.json'))['results']
+    (partial,) = staged.values()
+    assert partial['stage'] == 'preempted'
+    assert partial['preempted'] is True
+    assert partial['completed_reps'] == 1
+    # the announcement made it into the trace (preempted, not silent)
+    records, _ = read_trace(str(tmp_path / 'TRACE'))
+    names = {r.get('name') for r in records if r.get('t') == 'span'}
+    assert 'resilience.preempted' in names
+
+    p2 = subprocess.run([sys.executable, bench, '--config', '32',
+                         '2000'], capture_output=True, timeout=560,
+                        env=env_base)
+    assert p2.returncode == 0, p2.stderr.decode()[-2000:]
+    rec = json.loads(p2.stdout.decode().strip().splitlines()[-1])
+    assert rec['resumed'] is True and rec['resumed_reps'] == 1
+    assert rec['value'] > 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance (slow): 2-process kill -> live detect -> shrink -> resume
+
+@pytest.mark.slow
+def test_fleet_kill_detect_reform_resume(tmp_path):
+    """The full survivability choreography on a real 2-process gloo
+    fleet: rank 1 is SIGKILLed entering rep 2 (after seq 1 sealed);
+    rank 0's live monitor detects the dead peer within the gap
+    threshold and exits DEAD_RANK_EXIT instead of wedging in the paint
+    collective; the 1-process relaunch re-forms the mesh, repartitions
+    the surviving shards, resumes at rep 2 — and the final power
+    matches an uninterrupted single-process run."""
+    trace = tmp_path / 'trace'
+    ckpt = tmp_path / 'ckpt'
+    record = tmp_path / 'rec.json'
+    env = dict(os.environ,
+               JAX_PLATFORMS='cpu',
+               NBKIT_DIAGNOSTICS=str(trace),
+               NBKIT_DIAGNOSTICS_HEARTBEAT='0.25',
+               NBKIT_FLEET_DIR=str(ckpt),
+               NBKIT_FLEET_RECORD=str(record),
+               NBKIT_FLEET_GAP_S='1.5',
+               NBKIT_FAULTS='rank1@bench.rep@2:sigkill')
+    os.makedirs(ckpt)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, '127.0.0.1:12365', '2', str(i),
+         'fleet'], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out.decode(errors='replace'))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    assert procs[1].returncode == -signal.SIGKILL, outs[1][-2000:]
+    assert procs[0].returncode == DEAD_RANK_EXIT, outs[0][-2000:]
+    # seq 1 sealed by both ranks before the kill
+    store = FleetCheckpointStore(ckpt)
+    man = store.latest_manifest('fleet.pipeline')
+    assert man is not None and man['nranks'] == 2
+    sealed_seq = man['seq']
+    assert sealed_seq >= 1
+
+    # shrink-to-survive relaunch: ONE process, no faults
+    env2 = dict(env)
+    env2.pop('NBKIT_FAULTS')
+    p = subprocess.run([sys.executable, WORKER, 'none', '1', '0',
+                        'fleet'], env=env2, capture_output=True,
+                       timeout=420)
+    assert p.returncode == 0, p.stderr.decode()[-2000:]
+    m = re.search(rb'FLEETRESULT (\d+) (\d+) (\S+) (\S+)', p.stdout)
+    assert m, p.stdout[-2000:]
+    total, p2v = float(m.group(3)), float(m.group(4))
+    rec = json.load(open(record))
+    assert rec['resumed'] is True
+    assert rec['resumed_reps'] == sealed_seq
+    assert rec['reformed_from'] == 2 and rec['reformed_to'] == 1
+
+    # ...and the survivor's answer matches an uninterrupted run
+    env3 = dict(env2, NBKIT_FLEET_DIR=str(tmp_path / 'ckpt-clean'),
+                NBKIT_FLEET_RECORD=str(tmp_path / 'rec-clean.json'))
+    os.makedirs(tmp_path / 'ckpt-clean')
+    q = subprocess.run([sys.executable, WORKER, 'none', '1', '0',
+                        'fleet'], env=env3, capture_output=True,
+                       timeout=420)
+    assert q.returncode == 0, q.stderr.decode()[-2000:]
+    mq = re.search(rb'FLEETRESULT (\d+) (\d+) (\S+) (\S+)', q.stdout)
+    np.testing.assert_allclose(total, float(mq.group(3)), rtol=1e-5)
+    np.testing.assert_allclose(p2v, float(mq.group(4)), rtol=1e-4)
+
+    # the dead rank is visible in rank 0's trace, with its rank stamp
+    records, _ = read_trace(str(trace))
+    dead = [r for r in records if r.get('t') == 'span'
+            and r.get('name') == 'resilience.fleet.dead_rank']
+    assert dead, 'no dead-rank event in the monitor trace'
+    reform = [r for r in records if r.get('t') == 'span'
+              and r.get('name') == 'resilience.fleet.reform']
+    assert reform and reform[0]['attrs']['from'] == 2
