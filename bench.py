@@ -109,9 +109,14 @@ _FFT_OPTS = {}
 
 
 def _parse_fft_flags(argv):
-    """Strip the global ``--fft-decomp slab|pencil|auto`` and
-    ``--pencil PXxPY`` flags from an argv list (any subcommand may
-    carry them) and stage the overrides for :func:`_setup_jax`."""
+    """Strip the global ``--fft-decomp slab|pencil|auto``,
+    ``--pencil PXxPY``, ``--mesh-dtype f4|bf16`` and
+    ``--a2a-compress none|bf16|int16`` flags from an argv list (any
+    subcommand may carry them) and stage the overrides for
+    :func:`_setup_jax`.  The precision flags select the ISSUE 13
+    half-storage/compressed-wire paths; every record's ``tuned:{...}``
+    block stamps the resolved values so hardware-window numbers stay
+    attributable."""
     out = []
     it = iter(argv)
     for a in it:
@@ -123,13 +128,41 @@ def _parse_fft_flags(argv):
             _FFT_OPTS['fft_pencil'] = next(it)
         elif a.startswith('--pencil='):
             _FFT_OPTS['fft_pencil'] = a.split('=', 1)[1]
+        elif a == '--mesh-dtype':
+            _FFT_OPTS['mesh_dtype'] = next(it)
+        elif a.startswith('--mesh-dtype='):
+            _FFT_OPTS['mesh_dtype'] = a.split('=', 1)[1]
+        elif a == '--a2a-compress':
+            _FFT_OPTS['a2a_compress'] = next(it)
+        elif a.startswith('--a2a-compress='):
+            _FFT_OPTS['a2a_compress'] = a.split('=', 1)[1]
         else:
             out.append(a)
     if _FFT_OPTS.get('fft_decomp') not in (None, 'slab', 'pencil',
                                            'auto'):
         raise SystemExit('--fft-decomp must be slab, pencil or auto '
                          '(got %r)' % _FFT_OPTS['fft_decomp'])
+    if _FFT_OPTS.get('mesh_dtype') not in (None, 'f4', 'bf16', 'auto'):
+        raise SystemExit('--mesh-dtype must be f4, bf16 or auto '
+                         '(got %r)' % _FFT_OPTS['mesh_dtype'])
+    if _FFT_OPTS.get('a2a_compress') not in (None, 'none', 'bf16',
+                                             'int16', 'auto'):
+        raise SystemExit('--a2a-compress must be none, bf16, int16 or '
+                         'auto (got %r)' % _FFT_OPTS['a2a_compress'])
     return out
+
+
+def _bench_mesh_dtype(Nmesh=None):
+    """The mesh storage dtype this bench process runs with: the
+    ``--mesh-dtype`` override when given (staged into
+    ``set_options(mesh_dtype=...)`` by :func:`_setup_jax`), resolved
+    through the tune cache for 'auto', else 'f4'."""
+    from nbodykit_tpu import _global_options
+    v = _global_options['mesh_dtype']
+    if v in (None, 'auto'):
+        from nbodykit_tpu.tune.resolve import resolve_mesh_dtype
+        return resolve_mesh_dtype(nmesh=Nmesh)
+    return v
 
 
 def _utcnow():
@@ -491,7 +524,8 @@ def run_config(Nmesh, Npart, method='scatter', reps=2, phases=True):
                              paint_chunk_size=1024 * 1024 * 16)
     from nbodykit_tpu.diagnostics import span as _span
     from nbodykit_tpu.diagnostics import instrumented_jit as _ijit
-    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
+    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0,
+                      dtype=_bench_mesh_dtype(Nmesh))
     with _span('bench.make_pos', npart=Npart, nmesh=Nmesh):
         pos = _make_pos(jax, jnp, Npart, 1000.0)
     fused, phase_fns = _bench_fftpower_fn(pm)
@@ -983,7 +1017,8 @@ def run_fft_decomp(Nmesh=256, reps=3):
     rec['pencil'] = '%dx%d' % pxpy
     from nbodykit_tpu.pmesh import ParticleMesh
     with use_mesh(mesh):
-        pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
+        pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0,
+                          dtype=_bench_mesh_dtype(Nmesh))
         x = jax.random.uniform(jax.random.key(7), pm.shape_real,
                                jnp.float32)
         x = jax.device_put(x, pm.sharding())
@@ -1090,6 +1125,11 @@ def _paint_method_options(method, Nmesh, Npart):
         if cand.name == method:
             opts = dict(base)
             opts.update(cand.options)
+            # an explicit --mesh-dtype outranks the candidate's
+            # storage default: 'scatter --mesh-dtype bf16' means
+            # bf16 scatter, not the registered f4 variant
+            if _FFT_OPTS.get('mesh_dtype'):
+                opts['mesh_dtype'] = _FFT_OPTS['mesh_dtype']
             return opts
     opts = dict(base)
     if ':' in method:
@@ -1102,6 +1142,8 @@ def _paint_method_options(method, Nmesh, Npart):
         if len(parts) > 2:
             opts['paint_deposit'] = parts[2]
     opts['paint_method'] = method
+    if _FFT_OPTS.get('mesh_dtype'):
+        opts['mesh_dtype'] = _FFT_OPTS['mesh_dtype']
     return opts
 
 
@@ -1122,7 +1164,8 @@ def run_paint(Nmesh, Npart, method='scatter', reps=3):
     method_label = method      # metric key keeps the candidate name
     nbodykit_tpu.set_options(**_paint_method_options(
         method, Nmesh, Npart))
-    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0, dtype='f4')
+    pm = ParticleMesh(Nmesh=Nmesh, BoxSize=1000.0,
+                      dtype=_bench_mesh_dtype(Nmesh))
     pos = _make_pos(jax, jnp, Npart, 1000.0)
     fn = jax.jit(lambda p: pm.paint(p, 1.0, resampler='cic',
                                     return_dropped=True)[0])

@@ -33,8 +33,21 @@ __version__ = "0.1.0"
 # ---------------------------------------------------------------------------
 
 _default_options = {
-    # dtype used for meshes created via to_mesh() unless overridden
+    # dtype used for meshes created via to_mesh() unless overridden.
+    # 'bf16' stores mesh buffers in bfloat16 (half the HBM of 'f4')
+    # with f32-compensated deposit merges and immediate re-widening on
+    # readout/FFT entry (docs/PERF.md "Halving the bytes"); 'auto'
+    # consults the tune cache, falling back to 'f4'
     'mesh_dtype': 'f4',
+    # all_to_all payload compression for the distributed FFT
+    # (parallel/dfft.py, slab AND pencil drivers): 'none' sends the
+    # f32 complex shards as-is; 'bf16' casts the payload
+    # bfloat16-on-the-wire and re-widens to f32 immediately after the
+    # collective; 'int16' sends an int16-quantized payload with
+    # per-slab f32 scale factors carried alongside the shards. FFT
+    # stages always COMPUTE f32 — only the wire bytes halve. 'auto'
+    # consults the tune cache, falling back to 'none'
+    'a2a_compress': 'none',
     # number of particles painted per chunk on the host-streaming path
     'paint_chunk_size': 1024 * 1024 * 16,
     # slack factor for fixed-capacity particle exchange buffers
@@ -165,7 +178,23 @@ class set_options(object):
     Parameters
     ----------
     mesh_dtype : str
-        default dtype of meshes created by ``to_mesh``.
+        default dtype of meshes created by ``to_mesh``: 'f4' (the
+        default), 'f8' (demoted to f4 when x64 is off), 'bf16' (mesh
+        buffers stored bfloat16 at half the f4 HBM footprint — paint
+        deposits into bf16 replica meshes with an f32 compensated
+        two-sum merge, readout and FFT entry re-widen to f32
+        immediately; accuracy budget asserted in tests/
+        test_precision.py), or 'auto' (the tune-cache winner for this
+        platform/shape, falling back to 'f4').
+    a2a_compress : str
+        distributed-FFT ``all_to_all`` payload compression
+        (parallel/dfft.py, both slab and pencil): 'none' (default),
+        'bf16' (bfloat16 on the wire, f32 out — the payload is
+        re-widened immediately after the collective), 'int16'
+        (quantized payload + per-slab f32 scale factors riding
+        alongside), or 'auto' (tune-cache winner, falling back to
+        'none').  FFT butterflies always compute f32; only the wire
+        bytes halve.
     paint_chunk_size : int
         number of particles processed per chunk when streaming from host.
     exchange_slack : float

@@ -373,8 +373,20 @@ def _cast_source(source, BoxSize, Nmesh):
         source = FieldMesh(source)
     elif isinstance(source, CatalogSourceBase) and \
             not isinstance(source, MeshSource):
-        source = source.to_mesh(BoxSize=BoxSize, Nmesh=Nmesh, dtype='f8',
-                                compensated=True)
+        # honor set_options(mesh_dtype=...): 'f4' (the default) keeps
+        # the reference's 'f8' request — working_dtype canonicalizes it
+        # to f4 where x64 is off (TPU) — while 'bf16' halves the mesh
+        # storage (compute stays f32; see pmesh.ParticleMesh)
+        from .. import _global_options
+        mdt = _global_options['mesh_dtype']
+        if mdt == 'auto':
+            from ..tune.resolve import resolve_mesh_dtype
+            mdt = resolve_mesh_dtype(
+                nmesh=None if Nmesh is None
+                else int(np.max(np.atleast_1d(Nmesh))))
+        dtype = 'f8' if mdt in (None, 'f4') else mdt
+        source = source.to_mesh(BoxSize=BoxSize, Nmesh=Nmesh,
+                                dtype=dtype, compensated=True)
     if not isinstance(source, MeshSource):
         raise TypeError("unknown source type for FFT algorithm: %s"
                         % type(source))

@@ -29,6 +29,32 @@ from ...ops.gridhash import GridHash
 from ...utils import working_dtype
 from ...ops.devicehash import DeviceGridHash
 
+# one-time latch for the f8->f4 demotion diagnostic below: the event
+# is per-process (the contract does not change mid-run), so the
+# counter/trace noise must not scale with the chunk count
+_demotion_noted = [False]
+
+
+def _accumulator_dtype():
+    """The pair-histogram accumulator dtype: f8 when x64 is enabled,
+    else f4 — and when that demotion happens it is OBSERVABLE, not
+    silent: the first call bumps the one-time ``precision.demoted``
+    counter and emits a trace event naming the site.  Accumulating
+    ~N*s^3 weighted counts in f4 loses ~eps*sqrt(n_pairs) relative
+    mass per bin; callers needing the f8 contract must enable x64
+    (``jax.config.update('jax_enable_x64', True)``)."""
+    wdt = working_dtype('f8')
+    if wdt.itemsize < 8 and not _demotion_noted[0]:
+        _demotion_noted[0] = True
+        from ...diagnostics import counter, current_tracer
+        counter('precision.demoted').add(1)
+        tr = current_tracer()
+        if tr is not None:
+            tr.event('precision.demoted',
+                     {'site': 'pair_counters.core',
+                      'requested': 'f8', 'effective': wdt.name})
+    return wdt
+
 
 def rmax_of(mode, edges, pimax=None):
     """Max interaction radius of a mode/edges combination (used by
@@ -162,12 +188,19 @@ def paircount(pos1, w1, pos2, w2, box, edges, mode='1d', Nmu=None,
     Returns
     -------
     dict with 'npairs' and 'wnpairs' arrays of the binned shape.
+
+    Notes
+    -----
+    Histograms accumulate at :func:`_accumulator_dtype`: f8 under
+    x64, else f4 — the demotion bumps the one-time
+    ``precision.demoted`` counter/trace event rather than happening
+    silently.
     """
     pos1 = np.asarray(pos1, dtype='f8')
     pos2 = np.asarray(pos2, dtype='f8')
     w1 = np.ones(len(pos1)) if w1 is None else np.asarray(w1, 'f8')
     w2 = np.ones(len(pos2)) if w2 is None else np.asarray(w2, 'f8')
-    wdt = working_dtype('f8')  # f4 when x64 is off (TPU) — silent
+    wdt = _accumulator_dtype()  # f4 when x64 is off — observable
 
     p1, p2, work_box, redges, rmax, nb1, nb2, periodic = _mode_setup(
         pos1, pos2, box, edges, mode, Nmu, pimax, grid_origin, periodic)
@@ -215,13 +248,15 @@ def paircount_dist(pos1, w1, pos2, w2, box, edges, mesh, mode='1d',
     sharded jnp arrays and the counting runs domain-decomposed: no
     device ever gathers the catalogs. Requires rmax <= work_box_x / P
     (single-hop ghosts); callers fall back to :func:`paircount` when
-    that fails.
+    that fails.  Coordinates and histograms use
+    :func:`_accumulator_dtype` (f8 under x64, else f4 — the demotion
+    is counted, not silent).
     """
     from jax.sharding import PartitionSpec as P
     from ...parallel.domain import slab_route
     from ...parallel.runtime import AXIS, shard_leading
 
-    wdt = working_dtype('f8')  # f4 when x64 is off (TPU) — silent
+    wdt = _accumulator_dtype()  # f4 when x64 is off — observable
     pos1 = jnp.asarray(pos1, wdt)
     pos2 = jnp.asarray(pos2, wdt)
     n1 = pos1.shape[0]

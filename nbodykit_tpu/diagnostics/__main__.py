@@ -379,6 +379,27 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                                                   'BENCH_HISTORY.json')))
             else:
                 lines.append('regress      OK: %s' % desc)
+            # a committed tune winner running a halved-bytes posture
+            # (bf16 mesh, compressed a2a) with no recorded P(k)
+            # accuracy margin is an unattested speedup — loud, not
+            # blocking
+            prec = history.get('precision') or {}
+            if prec.get('unattested'):
+                warn.append('precision')
+                lines.append('precision    WARN: %d committed '
+                             'compressed winner(s) with no recorded '
+                             'P(k) margin vs the f32 oracle (%s) — '
+                             'run the precision gate '
+                             '(tests/test_precision.py writes '
+                             'PRECISION.json) before trusting the '
+                             'speedup'
+                             % (len(prec['unattested']),
+                                ', '.join(prec['unattested'])))
+            elif prec.get('margins'):
+                lines.append('precision    OK: %d accuracy margin(s) '
+                             'on record, every committed compressed '
+                             'winner attested'
+                             % len(prec['margins']))
 
     if root is not None and \
             not os.path.isdir(os.path.join(root, 'nbodykit_tpu')):

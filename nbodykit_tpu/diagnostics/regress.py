@@ -44,6 +44,7 @@ import time
 from .trace import atomic_write
 
 HISTORY_NAME = 'BENCH_HISTORY.json'
+PRECISION_NAME = 'PRECISION.json'
 ROUND_GLOBS = ('BENCH_r*.json', 'MULTICHIP_r*.json')
 CACHE_FILES = ('BENCH_TPU_CACHE.json', 'BASELINE_CPU.json')
 # note text that marks a headline as replayed from the TPU cache
@@ -404,6 +405,114 @@ def serve_summary(root):
     return latest
 
 
+# winner-option posture -> the margin key the precision harness
+# records in PRECISION.json (tests/test_precision.py and the smoke
+# precision gate both write through write_precision_margins)
+_MARGIN_KEYS = {('mesh_dtype', 'bf16'): 'mesh-bf16',
+                ('mesh_dtype', 'bfloat16'): 'mesh-bf16',
+                ('a2a_compress', 'bf16'): 'a2a-bf16',
+                ('a2a_compress', 'int16'): 'a2a-int16'}
+
+
+def _compressed_postures(options):
+    """Margin keys for every halved-bytes posture an options dict
+    carries ('' when it is the full-width default)."""
+    keys = []
+    for opt in ('mesh_dtype', 'a2a_compress'):
+        key = _MARGIN_KEYS.get((opt, str((options or {}).get(opt))))
+        if key:
+            keys.append(key)
+    return keys
+
+
+def write_precision_margins(margins, root='.', k_max='k_nyquist/2'):
+    """Commit measured P(k) accuracy margins to ``PRECISION.json``
+    (atomic).  ``margins`` maps margin key ('mesh-bf16' / 'a2a-bf16' /
+    'a2a-int16') to ``{'max_rel_err': float, 'budget': float}``;
+    existing keys are merged so the paint and fft gates can each
+    attest their own candidates.  This file is the evidence
+    :func:`precision_summary` pairs with committed tune-cache winners:
+    a compressed winner without a margin here is an unattested speedup
+    and the doctor WARNs on it."""
+    path = os.path.join(root, PRECISION_NAME)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    if not isinstance(doc.get('margins'), dict):
+        doc['margins'] = {}
+    doc['margins'].update({str(k): dict(v)
+                           for k, v in (margins or {}).items()})
+    doc['k_max'] = k_max
+    doc['measured_at'] = time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                       time.gmtime())
+    atomic_write(path, json.dumps(doc, indent=1, sort_keys=True))
+    return path
+
+
+def precision_summary(root, now=None):
+    """Precision posture for the round record: which compressed
+    (halved-bytes) candidates the tuner actually raced this database,
+    the measured max P(k) relative error vs the f32 oracle each
+    posture has on record (``PRECISION.json``, written by the accuracy
+    harness up to k_Nyquist/2), and the storage/wire dtype of every
+    committed winner.  A committed winner running bf16 mesh storage or
+    compressed all_to_all payloads WITHOUT a recorded margin lands in
+    ``unattested`` — the doctor WARNs on it, because a speedup nobody
+    accuracy-gated is a liability, not a result.  ``None`` when
+    neither TUNE_CACHE.json nor PRECISION.json exists; never raises.
+    """
+    tc_path = os.path.join(root, 'TUNE_CACHE.json')
+    pr_path = os.path.join(root, PRECISION_NAME)
+    if not os.path.exists(tc_path) and not os.path.exists(pr_path):
+        return None
+    try:
+        margins, k_max = {}, None
+        if os.path.exists(pr_path):
+            try:
+                with open(pr_path) as f:
+                    doc = json.load(f)
+                margins = dict(doc.get('margins') or {})
+                k_max = doc.get('k_max')
+            except (OSError, ValueError) as e:
+                return {'error': 'PRECISION.json unreadable: %s' % e}
+        raced, winners, unattested = set(), [], []
+        try:
+            with open(tc_path) as f:
+                entries = json.load(f).get('entries') or {}
+        except (OSError, ValueError):
+            entries = {}
+        for entry in entries.values():
+            if not isinstance(entry, dict):
+                continue
+            for name, rec in (entry.get('trials') or {}).items():
+                if isinstance(rec, dict) and \
+                        _compressed_postures(rec.get('options')):
+                    raced.add(name)
+            winner = entry.get('winner')
+            if not isinstance(winner, dict):
+                continue
+            postures = _compressed_postures(winner)
+            win = {'op': entry.get('op'),
+                   'shape_class': entry.get('shape_class'),
+                   'name': entry.get('winner_name'),
+                   'postures': postures,
+                   'attested': all(k in margins for k in postures)}
+            winners.append(win)
+            if postures and not win['attested']:
+                unattested.append('%s/%s=%s' % (win['op'],
+                                                win['shape_class'],
+                                                win['name']))
+        out = {'raced': sorted(raced), 'margins': margins,
+               'winners': winners, 'unattested': unattested}
+        if k_max is not None:
+            out['k_max'] = k_max
+        return out
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+
+
 def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
                   now=None, write=True):
     """Assemble + (atomically) write ``BENCH_HISTORY.json``; returns
@@ -423,6 +532,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'resilience': resilience_summary(root, now=now),
         'fleet': fleet_summary(root, now=now),
         'serve': serve_summary(root),
+        'precision': precision_summary(root, now=now),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
                            if e.get('verdict') == v)
@@ -521,6 +631,28 @@ def render_regress(history):
                  serve.get('lost', '?'),
                  ', faults injected at %s and survived'
                  % ', '.join(fpoints) if fpoints else ''))
+    prec = history.get('precision')
+    if prec is not None:
+        if 'error' in prec:
+            w('  precision: unavailable (%s)' % prec['error'])
+        else:
+            attested = ', '.join(
+                '%s err %.2e/budget %.0e'
+                % (k, v.get('max_rel_err', float('nan')),
+                   v.get('budget', float('nan')))
+                for k, v in sorted(prec.get('margins', {}).items()))
+            w('  precision: %d compressed candidate(s) raced, %d '
+              'margin(s) on record%s%s'
+              % (len(prec.get('raced', [])),
+                 len(prec.get('margins', {})),
+                 ' vs f32 oracle to %s (%s)'
+                 % (prec.get('k_max', '?'), attested)
+                 if attested else '',
+                 '; WARN — %d committed winner(s) running a halved-'
+                 'bytes posture with NO recorded P(k) margin: %s'
+                 % (len(prec['unattested']),
+                    ', '.join(prec['unattested']))
+                 if prec.get('unattested') else ''))
     tune = history.get('tune')
     if tune is not None:
         if 'error' in tune:

@@ -447,7 +447,8 @@ def paint_local_segsum(pos, mass, shape, resampler='cic', period=None,
 
 
 def paint_local_streams(pos, mass, shape, resampler='cic', period=None,
-                        origin=0, out=None, streams=4, chunk=None):
+                        origin=0, out=None, streams=4, chunk=None,
+                        storage_dtype=None):
     """Offset-stream scatter: k independent scatter chains, one sum.
 
     XLA lowers scatter-add to a serial per-element loop and the plain
@@ -472,7 +473,19 @@ def paint_local_streams(pos, mass, shape, resampler='cic', period=None,
         :func:`paint_local`'s chain).
     chunk : particles per scatter pass, as in :func:`paint_local`
         (the replica tuple is the fori_loop carry).
+    storage_dtype : when a narrow float (bfloat16), the replica meshes
+        are stored at that width — half the HBM of the f32 replicas,
+        THE dominant term of this method's memory_plan — while every
+        deposit weight is computed f32 and split two-sum style: the
+        bf16-representable ``hi`` part and the f32 residual ``lo`` land
+        on different replicas, and the merge step re-widens each
+        replica to f32 BEFORE the pairwise tree sum (the compensated
+        accumulation of the NBK701/702 contracts).  The returned field
+        is f32 (compute dtype); callers narrow to storage once, at
+        their own exit.  None (default) keeps today's single-width
+        behavior.
     """
+    from ..utils import is_narrow_float
     n0l, N1, N2 = (int(x) for x in shape)
     if period is None:
         period = shape
@@ -482,15 +495,22 @@ def paint_local_streams(pos, mass, shape, resampler='cic', period=None,
     k = max(1, min(int(streams), s ** 3))
     dtype = out.dtype if out is not None else (
         mass.dtype if hasattr(mass, 'dtype') else pos.dtype)
+    narrow = storage_dtype is not None and is_narrow_float(storage_dtype)
+    # rdtype: what the replica meshes STORE; weights always compute
+    # at least f32 wide (mdtype) — bf16 is never an arithmetic dtype
+    rdtype = np.dtype(storage_dtype) if narrow else dtype
+    mdtype = jnp.float32 if narrow else dtype
     counter('paint.trace.streams').add(1)
     counter('paint.trace.streams_particles').add(int(n))
     gauge('paint.trace.stream_count').set(k)
-    mass = jnp.broadcast_to(jnp.asarray(mass, dtype=dtype), (n,))
+    if narrow:
+        counter('paint.trace.streams_narrow').add(1)
+    mass = jnp.broadcast_to(jnp.asarray(mass, dtype=mdtype), (n,))
 
     # data-derived zero: under shard_map the fori_loop carry must have
     # the same varying-manual-axes type as the per-step update
-    zinit = jnp.zeros((), dtype) + jnp.sum(mass[:1]) * 0
-    flats = [jnp.zeros(n0l * N1 * N2, dtype=dtype) + zinit
+    zinit = jnp.zeros((), rdtype) + (jnp.sum(mass[:1]) * 0).astype(rdtype)
+    flats = [jnp.zeros(n0l * N1 * N2, dtype=rdtype) + zinit
              for _ in range(k)]
 
     def body(pos_c, mass_c, flats):
@@ -499,7 +519,19 @@ def paint_local_streams(pos, mass, shape, resampler='cic', period=None,
                 pos_c, mass_c, resampler, period, origin, n0l)):
             # round-robin deal: adjacent offsets land on different
             # replicas, so no chain carries two consecutive streams
-            flats[j % k] = flats[j % k].at[lin].add(w.astype(dtype))
+            if narrow:
+                # two-sum split of the f32 weight: hi is the
+                # bf16-representable part, lo the residual it lost —
+                # deposited on the NEXT replica so the correction
+                # survives until the f32 merge
+                w32 = w.astype(jnp.float32)
+                hi = w32.astype(jnp.bfloat16)
+                lo = w32 - hi.astype(jnp.float32)
+                flats[j % k] = flats[j % k].at[lin].add(hi)
+                flats[(j + 1) % k] = flats[(j + 1) % k].at[lin].add(
+                    lo.astype(jnp.bfloat16))
+            else:
+                flats[j % k] = flats[j % k].at[lin].add(w.astype(dtype))
         return tuple(flats)
 
     if chunk is None or chunk >= n:
@@ -520,6 +552,11 @@ def paint_local_streams(pos, mass, shape, resampler='cic', period=None,
 
     # pairwise tree sum: log2(k) dependent adds instead of k
     flats = list(flats)
+    if narrow:
+        # the merge step re-widens FIRST: replicas stored bf16, the
+        # accumulation across replicas runs f32 (NBK703: never add
+        # mesh-sized operands at mixed widths)
+        flats = [f.astype(jnp.float32) for f in flats]
     while len(flats) > 1:
         nxt = [a + b for a, b in zip(flats[::2], flats[1::2])]
         if len(flats) % 2:
@@ -527,7 +564,7 @@ def paint_local_streams(pos, mass, shape, resampler='cic', period=None,
         flats = nxt
     flat = flats[0]
     if out is not None:
-        flat = flat + jnp.asarray(out).reshape(-1)
+        flat = flat + jnp.asarray(out).reshape(-1).astype(flat.dtype)
     return flat.reshape(shape)
 
 

@@ -94,6 +94,123 @@ def _fft_chunk_bytes(shape=None, dtype=None, mesh_shape=None):
                                    mesh_shape=mesh_shape)
 
 
+def _a2a_mode(shape=None, dtype=None, mesh_shape=None):
+    """The resolved ``a2a_compress`` wire format for the next
+    transform: 'none' (f32/f64 complex payload, today's behavior),
+    'bf16' (half-width planes on the wire, re-widened on receipt) or
+    'int16' (quantized planes with per-source-shard scale factors).
+    ``'auto'`` consults the tune cache like
+    :func:`_fft_chunk_bytes` does; resolution happens here, at
+    closure-build/trace time, so the compiled program carries one
+    concrete format."""
+    from .. import _global_options
+    v = _global_options['a2a_compress']
+    if v in (None, False, 'none'):
+        return 'none'
+    if v == 'auto':
+        from ..tune.resolve import resolve_a2a_compress
+        return resolve_a2a_compress(shape=shape, dtype=dtype or 'f4',
+                                    mesh_shape=mesh_shape)
+    return str(v)
+
+
+def _a2a(y, axis_name, split_axis, concat_axis, nsplit, mode='none'):
+    """One FFT transpose collective with an optional compressed wire
+    format (ROADMAP item 5: the distributed FFT is all_to_all-bound,
+    so halving the bytes on the wire halves the measured ceiling).
+
+    The transform stages COMPUTE at full width either side of this
+    call; compression exists only between the split and the concat:
+
+    - ``'bf16'``: the complex payload is carried as a stacked
+      (real, imag) plane pair cast to bfloat16 — half the bytes — and
+      re-widened immediately on the receiving side (the literal
+      ``.astype`` on the collective is the NBK701 contract).
+    - ``'int16'``: the plane pair is quantized to int16 against ONE
+      scalar scale per source shard (max|planes|/32767, clamped away
+      from zero); the scale rides the SAME all_to_all payload —
+      bitcast to two int16 lanes appended along the concat axis — so
+      each received block carries its sender's scale and no second
+      collective is needed.  Half the bytes of 'bf16's exponent-heavy
+      format spent on mantissa instead — better for fields with
+      narrow dynamic range per shard, worse across decades.
+
+    ``nsplit`` is the group size of ``axis_name`` (the number of
+    blocks the concat axis is composed of — slab: P, pencil inner:
+    Py, pencil outer: Px).
+
+    ``mode`` is static configuration resolved at closure-build time
+    (:func:`_a2a_mode`), so the branch below is compiled away; every
+    mode emits exactly ONE all_to_all and nothing else — the
+    collective program is identical on every arm and every rank
+    (NBK103 by construction)."""
+    if mode == 'bf16':
+        out = _a2a_bf16(y, axis_name, split_axis, concat_axis, nsplit)
+    elif mode == 'int16':
+        out = _a2a_int16(y, axis_name, split_axis, concat_axis,
+                         nsplit)
+    else:
+        out = _a2a_plain(y, axis_name, split_axis, concat_axis,
+                         nsplit)
+    return out
+
+
+def _a2a_plain(y, axis_name, split_axis, concat_axis, nsplit):
+    return jax.lax.all_to_all(y, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def _a2a_bf16(y, axis_name, split_axis, concat_axis, nsplit):
+    counter('fft.trace.a2a_bf16').add(1)
+    planes = jnp.stack([jnp.real(y), jnp.imag(y)])
+    # the stacked plane axis is leading: split/concat shift by 1.
+    # The wire carries bf16; the re-widen lands on f32 (the bf16
+    # payload holds no more precision than f32 can represent, so an
+    # f64 input loses nothing beyond what the wire already dropped)
+    narrow = planes.astype(jnp.bfloat16)
+    wide = jax.lax.all_to_all(
+        narrow, axis_name, split_axis=split_axis + 1,
+        concat_axis=concat_axis + 1, tiled=True).astype(jnp.float32)
+    return jax.lax.complex(wide[0], wide[1]).astype(y.dtype)
+
+
+def _a2a_int16(y, axis_name, split_axis, concat_axis, nsplit):
+    counter('fft.trace.a2a_int16').add(1)
+    planes = jnp.stack([jnp.real(y), jnp.imag(y)])
+    wdt = planes.dtype
+    # one scalar scale per source shard, computed and applied in f32
+    # so the wire encoding is exact regardless of x64
+    scale = jnp.maximum(jnp.max(jnp.abs(planes)),
+                        jnp.asarray(1e-30, wdt))
+    scale = (scale / 32767.0).astype(jnp.float32)
+    qi = jnp.round(planes / scale.astype(wdt)).astype(jnp.int16)
+    # the scale rides the payload: bitcast f32 -> 2 int16 lanes,
+    # appended along the concat axis of every destination block, so
+    # one all_to_all moves data AND scales (no trailing all_gather)
+    sa, ca = split_axis + 1, concat_axis + 1
+    scode = jax.lax.bitcast_convert_type(scale, jnp.int16)
+    lane = jnp.reshape(scode, (1,) * ca + (2,)
+                       + (1,) * (qi.ndim - ca - 1))
+    pad_shape = qi.shape[:ca] + (2,) + qi.shape[ca + 1:]
+    wire = jnp.concatenate(
+        [qi, jnp.broadcast_to(lane, pad_shape)], axis=ca)
+    qr = jax.lax.all_to_all(wire, axis_name, split_axis=sa,
+                            concat_axis=ca, tiled=True)
+    # the received concat axis is nsplit sender blocks in source
+    # order, each data rows then its 2-lane scale: dequantize each
+    # block by its sender's scale
+    m = qi.shape[ca]
+    moved = jnp.moveaxis(qr, ca, 0)
+    blocks = moved.reshape((nsplit, m + 2) + moved.shape[1:])
+    codes = blocks[:, m:].reshape((nsplit, 2, -1))[:, :, 0]
+    scales = jax.lax.bitcast_convert_type(codes, jnp.float32)
+    wide = blocks[:, :m].astype(wdt) * scales.astype(wdt).reshape(
+        (nsplit,) + (1,) * (blocks.ndim - 1))
+    wide = jnp.moveaxis(
+        wide.reshape((nsplit * m,) + moved.shape[1:]), 0, ca)
+    return jax.lax.complex(wide[0], wide[1]).astype(y.dtype)
+
+
 def _lowmem_step(emit, upd, slab, buf, arr, k, r, stage):
     """One eager chunk of a lowmem pass, optionally wrapped in an
     ``fft.chunk`` span + wall histogram.  The per-chunk wall is
@@ -544,9 +661,9 @@ def _fft_chunked(a, axis, norm, target, inverse=False):
 
 @_lru_cache(maxsize=32)
 def _pencil_programs(mesh, shape, dtype_str, norm, kind, target,
-                     n_out=None):
+                     n_out=None, a2a='none'):
     """The two stage programs of one pencil transform, cached per
-    (mesh, shape, dtype, norm, kind).
+    (mesh, shape, dtype, norm, kind, a2a wire format).
 
     ``kind`` is 'r2c', 'c2r', 'c2c' or 'ic2c'. Returns
     (stage1, stage2, jit1, jit2, pad): ``stage1``/``stage2`` are the
@@ -591,16 +708,14 @@ def _pencil_programs(mesh, shape, dtype_str, norm, kind, target,
                 y = _fft_chunked(xl.astype(cdt), 2, norm, target)
             if pad:
                 y = jnp.pad(y, ((0, 0), (0, 0), (0, pad)))
-            y = jax.lax.all_to_all(y, AXIS_Y, split_axis=2,
-                                   concat_axis=1, tiled=True)
+            y = _a2a(y, AXIS_Y, 2, 1, py, a2a)
             return _fft_chunked(y, 1, norm, target)
 
         def stage2(yl):
             # y-pencils (N0/Px, N1, Nzp/Py): the OUTER transpose
             # (y <-> x across 'x' groups), the x-axis transform, and
             # the transposed (ky-leading) output layout
-            y = jax.lax.all_to_all(yl, AXIS_X, split_axis=1,
-                                   concat_axis=0, tiled=True)
+            y = _a2a(yl, AXIS_X, 1, 0, px, a2a)
             y = _fft_chunked(y, 0, norm, target)
             return jnp.transpose(y, (1, 0, 2))
 
@@ -612,16 +727,14 @@ def _pencil_programs(mesh, shape, dtype_str, norm, kind, target,
             # transform, then the OUTER transpose back
             z = jnp.transpose(yl, (1, 0, 2))
             z = _fft_chunked(z, 0, norm, target, inverse=True)
-            z = jax.lax.all_to_all(z, AXIS_X, split_axis=0,
-                                   concat_axis=1, tiled=True)
+            z = _a2a(z, AXIS_X, 0, 1, px, a2a)
             return _fft_chunked(z, 1, norm, target, inverse=True)
 
         def stage2(zl):
             # y-pencils (N0/Px, N1, Nzp/Py): the INNER transpose back
             # (z whole again), drop the pad locally, undo the z-axis
             # transform
-            z = jax.lax.all_to_all(zl, AXIS_Y, split_axis=1,
-                                   concat_axis=2, tiled=True)
+            z = _a2a(zl, AXIS_Y, 1, 2, py, a2a)
             if pad:
                 z = z[:, :, :Nz]
             if kind == 'c2r':
@@ -655,7 +768,8 @@ def _pencil_run(x, mesh, norm, kind, Nz_out=None):
         or 2 ** 31
     s1, s2, j1, j2, pad = _pencil_programs(
         mesh, tuple(int(n) for n in x.shape), str(x.dtype), norm, kind,
-        int(target), None if Nz_out is None else int(Nz_out))
+        int(target), None if Nz_out is None else int(Nz_out),
+        _a2a_mode(x.shape, x.dtype, mesh_shape=(px, py)))
     eager = not isinstance(x, jax.core.Tracer)
     if kind in ('c2r', 'ic2c') and pad:
         # the complex input's z axis is padded back to the transform's
@@ -764,12 +878,13 @@ def _dist_rfftn_impl(x, mesh, norm):
     if N0 % nproc or N1 % nproc:
         raise ValueError("Nmesh[0] and Nmesh[1] must be divisible by the "
                          "device count %d, got %s" % (nproc, (N0, N1, N2)))
+    a2a = _a2a_mode(x.shape, x.dtype)
 
     def local(xl):
         y = jnp.fft.rfft(xl, axis=2, norm=norm)
         y = jnp.fft.fft(y, axis=1, norm=norm)
         # (N0/P, N1, Nc) -> (N0, N1/P, Nc)
-        y = jax.lax.all_to_all(y, AXIS, split_axis=1, concat_axis=0, tiled=True)
+        y = _a2a(y, AXIS, 1, 0, nproc, a2a)
         y = jnp.fft.fft(y, axis=0, norm=norm)
         return jnp.transpose(y, (1, 0, 2))
 
@@ -817,12 +932,14 @@ def _dist_irfftn_impl(y, Nmesh2, mesh, norm):
         yt = jnp.transpose(y, (1, 0, 2))
         return jnp.fft.irfftn(yt, s=(yt.shape[0], yt.shape[1], Nmesh2), norm=norm)
 
+    a2a = _a2a_mode(y.shape, y.dtype)
+
     def local(yl):
         # (N1/P, N0, Nc) -> (N0, N1/P, Nc)
         z = jnp.transpose(yl, (1, 0, 2))
         z = jnp.fft.ifft(z, axis=0, norm=norm)
         # (N0, N1/P, Nc) -> (N0/P, N1, Nc)
-        z = jax.lax.all_to_all(z, AXIS, split_axis=0, concat_axis=1, tiled=True)
+        z = _a2a(z, AXIS, 0, 1, nproc, a2a)
         z = jnp.fft.ifft(z, axis=1, norm=norm)
         return jnp.fft.irfft(z, n=Nmesh2, axis=2, norm=norm)
 
@@ -929,18 +1046,19 @@ def _dist_fftn_c2c_impl(x, mesh, inverse, norm):
             return jnp.fft.ifftn(y, norm=norm)
         return jnp.transpose(jnp.fft.fftn(x, norm=norm), (1, 0, 2))
 
+    a2a = _a2a_mode(x.shape, x.dtype)
     if not inverse:
         def local(xl):
             y = fft(xl, axis=2, norm=norm)
             y = fft(y, axis=1, norm=norm)
-            y = jax.lax.all_to_all(y, AXIS, split_axis=1, concat_axis=0, tiled=True)
+            y = _a2a(y, AXIS, 1, 0, nproc, a2a)
             y = fft(y, axis=0, norm=norm)
             return jnp.transpose(y, (1, 0, 2))
     else:
         def local(yl):
             z = jnp.transpose(yl, (1, 0, 2))
             z = fft(z, axis=0, norm=norm)
-            z = jax.lax.all_to_all(z, AXIS, split_axis=0, concat_axis=1, tiled=True)
+            z = _a2a(z, AXIS, 0, 1, nproc, a2a)
             z = fft(z, axis=1, norm=norm)
             return fft(z, axis=2, norm=norm)
 

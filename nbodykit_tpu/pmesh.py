@@ -71,11 +71,18 @@ class ParticleMesh(object):
     def __init__(self, Nmesh, BoxSize, dtype='f4', comm=None):
         self.Nmesh = _triplet(Nmesh, 'i8')
         self.BoxSize = _triplet(BoxSize, 'f8')
-        from .utils import working_dtype
+        from .utils import is_narrow_float, mesh_storage_dtype
         # canonicalize up front: an f8 mesh with x64 disabled (the TPU
         # reality) IS an f4 mesh — deciding here keeps every kernel
-        # below free of per-callsite truncation warnings
-        self.dtype = working_dtype(dtype)
+        # below free of per-callsite truncation warnings.  'bf16' is a
+        # STORAGE dtype only: mesh buffers are bfloat16 (half the f4
+        # HBM) while everything computed over them — deposit weights,
+        # FFT butterflies, readout gathers — runs in ``compute_dtype``
+        # (f32) and narrows back at the buffer boundary (docs/PERF.md
+        # "Halving the bytes"; accuracy gate in tests/test_precision.py)
+        self.dtype = mesh_storage_dtype(dtype)
+        self.compute_dtype = np.dtype('f4') \
+            if is_narrow_float(self.dtype) else self.dtype
         self.comm = CurrentMesh.resolve(comm)
         self.nproc = mesh_size(self.comm)
         if int(self.Nmesh[0]) % self.nproc or int(self.Nmesh[1]) % self.nproc:
@@ -134,7 +141,16 @@ class ParticleMesh(object):
 
     def r2c(self, real):
         """Forward real-to-complex FFT, forward-normalized (pmesh
-        convention: divides by Nmesh^3 so the result is 'dimensionless')."""
+        convention: divides by Nmesh^3 so the result is 'dimensionless').
+
+        Narrow-storage (bf16) meshes re-widen to f32 at this boundary:
+        the FFT stages always compute f32 — storage never reaches a
+        butterfly (wire-level compression is the separate
+        ``a2a_compress`` knob in parallel/dfft.py)."""
+        from .utils import is_narrow_float
+        real = jnp.asarray(real)
+        if is_narrow_float(real.dtype):
+            real = real.astype(jnp.float32)
         return self._plan.r2c(real) * (1.0 / self.Ntot)
 
     def c2r(self, cplx):
@@ -148,7 +164,10 @@ class ParticleMesh(object):
         """Broadcastable real-space coordinate arrays [x, y, z] for the
         (N0, N1, N2) real layout: x_i = index * cellsize_i, in [0, L)."""
         from .utils import working_dtype
-        dtype = working_dtype(dtype or self.dtype)
+        # coordinates are compute-dtype: a bf16 storage mesh still gets
+        # f32 coordinate arrays (8 mantissa bits cannot index a lattice)
+        dtype = working_dtype(dtype) if dtype is not None \
+            else np.dtype(self.compute_dtype)
         out = []
         for ax, (n, h) in enumerate(zip(self.Nmesh, self.cellsize)):
             shape = [1, 1, 1]
@@ -344,8 +363,12 @@ class ParticleMesh(object):
         N0, N1, N2 = self.shape_real
         cpos = self._to_cell_units(pos) - shift
         npart = pos.shape[0]
+        # weights are COMPUTE dtype: with bf16 storage the deposit
+        # terms stay f32 and only the mesh buffers narrow (the streams
+        # kernel's replica meshes, via storage_dtype below, plus the
+        # final field cast at the exit)
         massa = jnp.broadcast_to(
-            jnp.asarray(mass, self.dtype), (npart,))
+            jnp.asarray(mass, self.compute_dtype), (npart,))
         # 'auto' options resolve through the tune cache here, at
         # dispatch time (cold cache -> today's defaults, no trials)
         pcfg = self._paint_config(npart)
@@ -389,10 +412,13 @@ class ParticleMesh(object):
                             jnp.zeros((), jnp.int32))
             elif pm_method == 'streams':
                 nstreams = pcfg['paint_streams']
+                sdt = self.dtype
 
                 def kern(*a, **kw):
                     return (paint_local_streams(*a, streams=nstreams,
-                                                chunk=chunk, **kw),
+                                                chunk=chunk,
+                                                storage_dtype=sdt,
+                                                **kw),
                             jnp.zeros((), jnp.int32))
             elif pm_method == 'mxu':
                 order = pcfg['paint_order']
@@ -426,7 +452,12 @@ class ParticleMesh(object):
                 block, over = make_kernel(mxu_slack)(
                     cpos, massa, self.shape_real, resampler=resampler,
                     period=self.shape_real, origin=0)
-            out = block if out is None else out + block
+            # kernels return compute dtype; widen any caller-held
+            # accumulator before adding (never mix widths on a
+            # mesh-sized operand) and narrow once at the exit
+            if out is not None:
+                block = block + jnp.asarray(out).astype(block.dtype)
+            out = block.astype(self.dtype)
             if return_dropped:
                 return out, over
             return out
@@ -453,7 +484,8 @@ class ParticleMesh(object):
             recv, valid, dropped = exchange_by_dest(
                 dest, [cpos, massa], self.comm, cap)
             cpos_r, mass_r = recv
-            mass_r = jnp.where(valid, mass_r, 0.0).astype(self.dtype)
+            mass_r = jnp.where(valid, mass_r,
+                               0.0).astype(self.compute_dtype)
             block, over = jax.shard_map(
                 make_local(kernel), mesh=self.comm,
                 in_specs=(P(AXIS, None), P(AXIS)),
@@ -489,7 +521,12 @@ class ParticleMesh(object):
                 "mxu paint bucket overflow (%d dropped); retrying "
                 "with slack=%g" % (int(over), mxu_slack))
             block, dropped, over = attempt(capacity, mxu_slack)
-        out = block if out is None else out + block
+        # same merge-then-narrow contract as the single-device exit:
+        # the halo_add ran in compute dtype inside the shard_map, the
+        # storage cast happens exactly once, here
+        if out is not None:
+            block = block + jnp.asarray(out).astype(block.dtype)
+        out = block.astype(self.dtype)
         if return_dropped:
             return out, dropped + over
         return out
@@ -559,6 +596,13 @@ class ParticleMesh(object):
 
     def _readout_impl(self, real, pos, resampler, capacity,
                       return_dropped):
+        from .utils import is_narrow_float
+        real = jnp.asarray(real)
+        if is_narrow_float(real.dtype):
+            # readout re-widens IMMEDIATELY (the NBK702 contract's
+            # read side): interpolation weights and gathers compute
+            # f32 — bf16 is a storage format, never an arithmetic one
+            real = real.astype(jnp.float32)
         resampler = resampler or _global_options['resampler']
         h = window_support(resampler)
         N0, N1, N2 = self.shape_real
@@ -694,15 +738,27 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     Py (``fft_pencil`` = (Px, Py); near-square default).  The report
     gains ``fft_pencil_buffers`` / ``fft_pencil`` keys so the smoke
     gate can assert the documented count at the 1024^3 config.
+
+    ``dtype='bf16'`` prices the half-storage mesh pipeline: the real
+    field and the streams-paint replica meshes are billed at 2 bytes
+    per cell, while everything that computes — FFT workspace, complex
+    field, positions, deposit terms, exchange payloads — stays at the
+    f32 compute width (the storage/compute split of docs/PERF.md
+    "Halving the bytes").  The report's ``mesh_dtype`` /
+    ``mesh_itemsize`` keys record what was priced so admission
+    rejections can quote it.
     """
     N = _triplet(Nmesh, 'i8')
     ndev = max(int(ndevices), 1)
-    item = np.dtype(dtype).itemsize
+    from .utils import mesh_storage_dtype
+    sdt = mesh_storage_dtype(dtype)
+    item = sdt.itemsize          # STORAGE width: mesh buffers
+    citem = max(item, 4)         # COMPUTE width: everything else
     ncells = float(np.prod(N))
     s = window_support(resampler or 'cic')
 
     real = item * ncells / ndev
-    cplx = 2 * item * (N[0] * N[1] * (N[2] // 2 + 1)) / ndev
+    cplx = 2 * citem * (N[0] * N[1] * (N[2] // 2 + 1)) / ndev
     fft_ws = 2 * cplx
     pencil_extra = {}
     if fft_decomp == 'pencil' and ndev > 1:
@@ -717,12 +773,12 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
         # holds PENCIL_BUFFERS of them at peak (stage-1 out + stage-2
         # out, stage 2 donating) — same 2x count as the slab model,
         # scaled by the z pad that makes Nc divisible by Py
-        stage = 2 * item * (N[0] * N[1] * ncp) / ndev
+        stage = 2 * citem * (N[0] * N[1] * ncp) / ndev
         fft_ws = PENCIL_BUFFERS * stage
         pencil_extra = {'fft_pencil': '%dx%d' % (px, py),
                         'fft_pencil_buffers': PENCIL_BUFFERS,
                         'fft_pencil_pad': float(ncp) / float(nc)}
-    pos_b = 3 * item * npart / ndev
+    pos_b = 3 * citem * npart / ndev
     if paint_chunk is None:
         chunk = _global_options['paint_chunk_size']
         if isinstance(chunk, bool) or not isinstance(chunk,
@@ -737,12 +793,12 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     if paint_method == 'sort':
         # all s^3 deposit terms live at once: (key i32 + val) pairs,
         # doubled by the sort's out-of-place buffers
-        paint_tmp = (s ** 3) * (4 + item) * (npart / ndev) * 2
+        paint_tmp = (s ** 3) * (4 + citem) * (npart / ndev) * 2
     elif paint_method == 'segsum':
         # same one-sort streams as 'sort', plus the segment_sum's
         # (n, s^3) totals and gathered run_tot buffers
-        paint_tmp = ((s ** 3) * (4 + item) * (npart / ndev) * 2
-                     + 2 * (s ** 3) * item * (npart / ndev))
+        paint_tmp = ((s ** 3) * (4 + citem) * (npart / ndev) * 2
+                     + 2 * (s ** 3) * citem * (npart / ndev))
     elif paint_method == 'streams':
         # k replica meshes (full mesh units each — THE cost of
         # breaking the scatter chain) next to the live chunk's
@@ -751,7 +807,9 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
             from .tune.resolve import effective_int_option
             paint_streams = effective_int_option('paint_streams')
         k = max(int(paint_streams), 1)
-        paint_tmp = k * real + (s ** 3) * (4 + item) * live
+        # replicas are STORAGE dtype (bf16 halves THE dominant term
+        # of this method); the live chunk's deposit terms compute f32
+        paint_tmp = k * real + (s ** 3) * (4 + citem) * live
     elif paint_method == 'mxu':
         # padded bucket payload (slack * (pos + mass)), the argsort of
         # the n keys (key + order i32, out-of-place), one x-stripe's
@@ -768,16 +826,16 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
         # accumulator (nty, M, N2) stays live across all pieces
         from .ops.paint import ZCHUNK_BYTES
         nty = max(-(-int(N[1]) // cb), 1)
-        blocks_acc = nty * rbh * cbh * int(N[2]) * item
-        stripe = min(slack * nl / ntx * (rbh * cbh + int(N[2])) * item,
+        blocks_acc = nty * rbh * cbh * int(N[2]) * citem
+        stripe = min(slack * nl / ntx * (rbh * cbh + int(N[2])) * citem,
                      float(ZCHUNK_BYTES) * (1 + rbh * cbh / int(N[2]))
                      ) + blocks_acc
-        paint_tmp = (slack * nl * 4 * item     # padded pos+mass
+        paint_tmp = (slack * nl * 4 * citem    # padded pos+mass
                      + nl * 8 * 2              # sort keys + order
                      + stripe
-                     + (rb + s) * int(N[1]) * int(N[2]) * item)
+                     + (rb + s) * int(N[1]) * int(N[2]) * citem)
     else:
-        paint_tmp = (s ** 3) * (4 + item) * live
+        paint_tmp = (s ** 3) * (4 + citem) * live
     p3 = cplx / 2               # |delta_k|^2 as real of the half-spec
     # multi-device particle routing: send + recv all_to_all buffers,
     # (P, capacity) payload slots each (pos 3*item + mass item + live
@@ -785,7 +843,7 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     #   counted: ~npart/P^2 * imbalance (two-pass counted exchange)
     #   ceil:    ceil(npart/P)          (traced always-sufficient)
     if ndev > 1:
-        payload = 3 * item + item + 1 + 4
+        payload = 3 * citem + citem + 1 + 4
         if exchange == 'ceil':
             cap = -(-npart // ndev)
         else:
@@ -801,6 +859,8 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
         'paint_temporaries': paint_tmp,
         'exchange_buffers': exch,
         'power3d': p3,
+        'mesh_dtype': sdt.name,
+        'mesh_itemsize': item,
     }
     phases.update(pencil_extra)
     # paint phase: field + positions + temporaries + exchange;

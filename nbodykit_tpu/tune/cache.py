@@ -38,7 +38,8 @@ import numpy as np
 TUNABLE_OPTIONS = ('paint_method', 'paint_order', 'paint_deposit',
                    'paint_chunk_size', 'paint_bucket_slack',
                    'paint_streams', 'fft_chunk_bytes', 'fft_decomp',
-                   'fft_pencil', 'exchange_slack')
+                   'fft_pencil', 'exchange_slack', 'mesh_dtype',
+                   'a2a_compress')
 
 STALE_DAYS = 30.0
 
@@ -130,7 +131,10 @@ def canonical_dtype(dtype):
     """Canonical dtype name for a cache key.  Complex dtypes map to
     their real base (``c8`` -> ``float32``): the FFT chunk target for a
     field is a property of its real footprint, and the tuner measures
-    the forward r2c."""
+    the forward r2c.  The ``'bf16'`` storage token (which ``np.dtype``
+    cannot parse) keys as ``bfloat16``."""
+    if str(dtype).lower() in ('bf16', 'bfloat16'):
+        return 'bfloat16'
     dt = np.dtype(dtype)
     if dt.kind == 'c':
         dt = np.dtype('f4' if dt.itemsize == 8 else 'f8')

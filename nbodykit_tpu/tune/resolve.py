@@ -34,6 +34,8 @@ FALLBACKS = {
     'fft_decomp': 'slab',          # cold cache: the proven decomposition
     'fft_pencil': None,            # near-square default (runtime.py)
     'exchange_slack': 1.05,
+    'mesh_dtype': 'f4',            # cold cache: full-width mesh storage
+    'a2a_compress': 'none',        # cold cache: uncompressed payloads
 }
 
 
@@ -174,6 +176,46 @@ def resolve_fft_decomp(shape=None, dtype='f4', nproc=1,
     return decomp, pencil
 
 
+def resolve_mesh_dtype(nmesh=None, npart=None, nproc=1):
+    """Concrete mesh STORAGE dtype token for
+    ``set_options(mesh_dtype='auto')``: the cache winner's
+    ``mesh_dtype`` for the nearest measured paint class (the knob is
+    raced inside the paint space — it changes the deposit kernels),
+    else ``'f4'`` (today's full-width behavior, the cold-cache
+    contract).  A winner may only answer 'f4' or 'bf16'; anything else
+    is treated as unmeasured."""
+    v = _current('mesh_dtype')
+    if v not in (None, 'auto'):
+        return str(v)
+    winner, _ = _consult('paint',
+                         shape_class(nmesh=nmesh, npart=npart)
+                         if (nmesh or npart) else 'mesh1', 'f4', nproc)
+    dt = winner.get('mesh_dtype', FALLBACKS['mesh_dtype'])
+    return dt if dt in ('f4', 'bf16') else FALLBACKS['mesh_dtype']
+
+
+def resolve_a2a_compress(shape=None, dtype='f4', nproc=1,
+                         mesh_shape=None):
+    """Concrete FFT all_to_all wire format for
+    ``set_options(a2a_compress='auto')``: the cache winner's
+    ``a2a_compress`` for the nearest measured fft class (the knob is
+    raced inside the fft space, keyed by the same (Px, Py)-aware shape
+    class as ``fft_decomp``), else ``'none'`` (uncompressed — the
+    cold-cache contract).  Only formats :func:`~nbodykit_tpu.parallel.
+    dfft._a2a` implements may win."""
+    v = _current('a2a_compress')
+    if v not in (None, 'auto'):
+        return str(v)
+    nmesh = int(max(shape)) if shape else None
+    winner, _ = _consult('fft',
+                         shape_class(nmesh=nmesh,
+                                     mesh_shape=mesh_shape) if nmesh
+                         else 'mesh1', dtype, nproc)
+    mode = winner.get('a2a_compress', FALLBACKS['a2a_compress'])
+    return mode if mode in ('none', 'bf16', 'int16') \
+        else FALLBACKS['a2a_compress']
+
+
 def resolve_exchange_slack(npart=None, nproc=1):
     """Concrete counted-exchange slack for ``slack='auto'``: the cache
     winner for the nearest measured particle class, else 1.05 (the
@@ -231,5 +273,13 @@ def tuned_snapshot(nmesh=None, npart=None, dtype='f4', nproc=1):
                        if (pxpy and decomp == 'pencil') else None),
         'fft_decomp_source': (
             'auto' if _current('fft_decomp') == 'auto' else 'explicit'),
+        # the precision posture this measurement ran with (ISSUE 13:
+        # compressed-candidate numbers must be attributable)
+        'mesh_dtype': resolve_mesh_dtype(nmesh=nmesh, npart=npart,
+                                         nproc=nproc),
+        'a2a_compress': resolve_a2a_compress(
+            shape=(nmesh,) * 3 if nmesh else None, dtype=dtype,
+            nproc=nproc,
+            mesh_shape=pxpy if decomp == 'pencil' else None),
         'cache': TuneCache().path,
     }

@@ -19,7 +19,9 @@ scatter on real hardware at every measured scale):
   0.85×HBM budget at the trial shape) × mxu deposit engine (``xla``
   vs ``pallas`` — MXU backends where the Pallas kernel provably
   lowers, :func:`~nbodykit_tpu.ops.paint_pallas.
-  pallas_deposit_lowers`);
+  pallas_deposit_lowers`) × mesh storage dtype (``mesh_dtype``:
+  ``f4`` vs ``bf16`` half-storage with two-sum compensated merges —
+  ISSUE 13, accuracy-gated by tests/test_precision.py);
 - **fft** — the single-device ``fft_chunk_bytes`` dispatch target
   (one-shot in-jit vs slab-chunked vs eager lowmem), and on
   multi-device contexts the ``fft_decomp`` knob (slab's one P-way
@@ -154,8 +156,27 @@ def _paint_candidates(ctx):
                                     'paint_order': 'radix',
                                     'paint_deposit': 'xla'}),
     ])
+    # half-storage mesh candidates (ISSUE 13): bf16 replica/field
+    # buffers halve the HBM traffic of the scatter-bound paint; the
+    # two-sum merge keeps the accuracy inside the tests/test_precision
+    # budget, and memory_plan prices the halved meshes so streams
+    # counts that only fit at 2 bytes/cell may compete here too
+    cands.append(Candidate('scatter-bf16', {'paint_method': 'scatter',
+                                            'mesh_dtype': 'bf16'}))
+    for k in (4, 8):
+        plan = memory_plan(int(ctx['nmesh']), int(ctx['npart']),
+                           dtype='bf16', paint_method='streams',
+                           paint_streams=k)
+        if plan['fits']:
+            cands.append(Candidate('streams%d-bf16' % k,
+                                   {'paint_method': 'streams',
+                                    'paint_streams': k,
+                                    'mesh_dtype': 'bf16'}))
     for c in cands:
         c.options.setdefault('paint_chunk_size', chunk)
+        # cold default = today's behavior: every candidate that did
+        # not ask for bf16 races (and would win as) full-width f4
+        c.options.setdefault('mesh_dtype', 'f4')
     if is_mxu_backend():
         # the Pallas VMEM deposit is interpreted (≈100x slow) off-MXU:
         # off-chip it would only ever lose, so it does not compete
@@ -182,10 +203,16 @@ def registered_paint_candidates(nmesh, npart, dtype='f4'):
 
 
 def _paint_runner(ctx):
+    from .. import _global_options
     from ..pmesh import ParticleMesh
+    # built inside the candidate's set_options block: a mesh_dtype
+    # the candidate carries (e.g. 'bf16') overrides the ctx dtype so
+    # the trial actually runs the half-storage pipeline
+    mdt = _global_options['mesh_dtype']
+    dtype = ctx.get('dtype', 'f4') if mdt in (None, 'auto') else mdt
     pm = ParticleMesh(Nmesh=int(ctx['nmesh']),
                       BoxSize=float(ctx.get('box', 1000.0)),
-                      dtype=ctx.get('dtype', 'f4'))
+                      dtype=dtype)
     pos = _trial_positions(ctx)
     resampler = ctx.get('resampler', 'cic')
 
@@ -197,7 +224,8 @@ def _paint_runner(ctx):
 def paint_space():
     return SearchSpace('paint',
                        ('paint_method', 'paint_order', 'paint_deposit',
-                        'paint_chunk_size', 'paint_streams'),
+                        'paint_chunk_size', 'paint_streams',
+                        'mesh_dtype'),
                        _paint_candidates, _paint_runner)
 
 
@@ -217,13 +245,35 @@ def _fft_candidates(ctx):
     # one P-way all_to_all. The factorization comes from the ctx (the
     # CLI stamps the one the transform would run with) so the entry's
     # shape class — and therefore the winner's reach — carries it.
+    # The a2a wire format races alongside (a2a_compress).
     nproc = int(ctx.get('nproc', 1))
+    if nproc > 1:
+        # compressed-wire candidates (ISSUE 13): the transposes are
+        # THE slab/pencil cost, so the a2a payload format races too —
+        # bf16 planes (half the bytes, re-widened on receipt) and
+        # int16 quantized planes with per-shard scales.  Single-device
+        # contexts have no collective, so the knob never races there.
+        cands.append(Candidate('slab-a2a-bf16',
+                               {'fft_decomp': 'slab',
+                                'fft_chunk_bytes': 2 ** 31,
+                                'a2a_compress': 'bf16'}))
+        cands.append(Candidate('slab-a2a-int16',
+                               {'fft_decomp': 'slab',
+                                'fft_chunk_bytes': 2 ** 31,
+                                'a2a_compress': 'int16'}))
     if nproc > 1 and ctx.get('mesh_shape'):
         px, py = ctx['mesh_shape']
         cands.append(Candidate(
             'pencil%dx%d' % (px, py),
             {'fft_decomp': 'pencil', 'fft_pencil': '%dx%d' % (px, py),
              'fft_chunk_bytes': 2 ** 31}))
+        cands.append(Candidate(
+            'pencil%dx%d-a2a-bf16' % (px, py),
+            {'fft_decomp': 'pencil', 'fft_pencil': '%dx%d' % (px, py),
+             'fft_chunk_bytes': 2 ** 31, 'a2a_compress': 'bf16'}))
+    for c in cands:
+        # cold default = today's behavior: uncompressed payloads
+        c.options.setdefault('a2a_compress', 'none')
     return cands
 
 
@@ -248,7 +298,8 @@ def _fft_runner(ctx):
 
 def fft_space():
     return SearchSpace('fft',
-                       ('fft_chunk_bytes', 'fft_decomp', 'fft_pencil'),
+                       ('fft_chunk_bytes', 'fft_decomp', 'fft_pencil',
+                        'a2a_compress'),
                        _fft_candidates, _fft_runner)
 
 

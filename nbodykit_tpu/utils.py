@@ -39,6 +39,31 @@ def working_dtype(dt='f8'):
     return dt
 
 
+def mesh_storage_dtype(dt='f4'):
+    """Resolve a mesh-buffer STORAGE dtype token, including the
+    ``'bf16'`` half-storage request that ``np.dtype`` cannot parse.
+
+    ``'bf16'``/``'bfloat16'`` resolves to the ml_dtypes-registered
+    bfloat16 (itemsize 2 — half the f4 mesh bytes; docs/PERF.md
+    "Halving the bytes").  Everything else goes through
+    :func:`working_dtype`, so f8 requests still demote to f4 when x64
+    is off.  Storage dtype only: compute (weights, FFT butterflies,
+    readout results) stays f32 — callers re-widen immediately
+    (NBK701/702 contracts, docs/LINT.md)."""
+    if str(dt).lower() in ('bf16', 'bfloat16'):
+        import jax.numpy as jnp
+        return np.dtype(jnp.bfloat16)
+    return working_dtype(dt)
+
+
+def is_narrow_float(dt):
+    """True when ``dt`` is a sub-f32 float storage dtype (bfloat16 or
+    float16) — the predicate behind every 'compute wide, store narrow'
+    branch in pmesh/ops.paint."""
+    dt = np.dtype(dt)
+    return dt.kind in 'fV' and dt.itemsize == 2
+
+
 def as_numpy(arr):
     """Fetch a jax array to host numpy.
 

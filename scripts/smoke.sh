@@ -125,6 +125,42 @@ print("pencil roundtrip OK: mesh128 4x2, max|irfftn(rfftn(x))-x| "
       "= %.3e" % err)
 '
 
+# halved-bytes precision gate (docs/PERF.md): a mesh64 FFTPower with
+# bf16 mesh storage AND bf16 all_to_all payloads on the 8-device CPU
+# mesh must stay inside the asserted P(k) budget vs the full-width
+# oracle up to k_Nyquist/2, with identical mode counts — the bounded
+# form of tests/test_precision.py, run on every smoke
+echo "== precision gate (mesh64, bf16 mesh + bf16 a2a) =="
+python -c '
+from nbodykit_tpu._jax_compat import set_cpu_devices
+set_cpu_devices(8)
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import nbodykit_tpu
+from nbodykit_tpu.lab import ArrayCatalog, FFTPower
+from nbodykit_tpu.parallel.runtime import cpu_mesh, use_mesh
+NMESH, BOX = 64, 200.0
+KMIN, DK = 0.31 * (2 * np.pi / BOX), 2.6718 * (2 * np.pi / BOX)
+pos = np.random.RandomState(42).uniform(0, BOX, (10000, 3))
+def pk(**opts):
+    with use_mesh(cpu_mesh()):
+        with nbodykit_tpu.set_options(**opts):
+            cat = ArrayCatalog({"Position": pos}, BoxSize=BOX)
+            r = FFTPower(cat, mode="1d", Nmesh=NMESH, kmin=KMIN, dk=DK)
+    return (np.asarray(r.power["k"], "f8"),
+            np.asarray(r.power["power"].real, "f8"),
+            np.asarray(r.power["modes"], "f8"))
+k0, p0, m0 = pk(mesh_dtype="f4", a2a_compress="none")
+k, p, m = pk(mesh_dtype="bf16", a2a_compress="bf16")
+np.testing.assert_array_equal(m, m0)
+sel = (m0 > 0) & np.isfinite(p0) & (k0 <= 0.5 * np.pi * NMESH / BOX)
+err = float((np.abs(p[sel] - p0[sel]) / np.abs(p0[sel]).mean()).max())
+assert err < 2e-2, "P(k) budget blown: %.3e" % err
+print("precision gate OK: bf16 mesh + bf16 a2a, max P(k) rel err "
+      "%.3e < 2e-2 (%d bins <= k_Nyq/2)" % (err, int(sel.sum())))
+'
+
 # autotuner gates (docs/TUNE.md): the bounded --dry-run proves the
 # deterministic trial plan still builds without touching a device —
 # and that every multi-device fft trial races BOTH decompositions
@@ -146,6 +182,15 @@ for p in ffts:
         "pencil decomposition candidate missing: %r" % cands)
     assert "-g" in p["shape_class"], (
         "factorization suffix missing: %r" % p["shape_class"])
+    # halved-bytes wire candidates (docs/PERF.md): every multi-device
+    # fft trial must race both compressed payloads against full-width
+    assert "slab-a2a-bf16" in cands and "slab-a2a-int16" in cands, (
+        "a2a compression candidates missing: %r" % cands)
+paints = [p for p in plan if p["op"] == "paint"]
+assert paints, "no paint trials in the plan"
+for p in paints:
+    assert "scatter-bf16" in p["candidates"], (
+        "bf16 mesh candidate missing: %r" % p["candidates"])
 print("tune plan OK: fft candidates " + " ".join(ffts[0]["candidates"])
       + " @ " + " ".join(p["shape_class"] for p in ffts))
 '

@@ -90,6 +90,42 @@ def test_nbk701_interprocedural_payload_fact():
     assert codes(fs) == ['NBK701']
 
 
+def test_nbk701_a2a_bf16_rewidened_negative():
+    # the dfft._a2a production idiom (ISSUE 13): all_to_all ships the
+    # stacked re/im planes as bf16 and the literal astype on the
+    # collective re-widens on arrival — bf16-on-wire/f32-out is the
+    # documented contract, not a silent demotion
+    fs = lint_str("""
+    import jax
+    import jax.numpy as jnp
+
+    def exchange(y, nsplit):
+        planes = jnp.stack([jnp.real(y), jnp.imag(y)])
+        planes = planes.astype(jnp.bfloat16)
+        wide = jax.lax.all_to_all(
+            planes, 'dev', 2, 1,
+            tiled=False).astype(jnp.float32)
+        return jax.lax.complex(wide[0], wide[1]).astype(y.dtype)
+    """, select=['NBK701'])
+    assert codes(fs) == []
+
+
+def test_nbk701_a2a_bf16_consumed_raw_positive():
+    # the same wire compression WITHOUT the re-widen: the narrow
+    # payload leaks into downstream arithmetic — flagged
+    fs = lint_str("""
+    import jax
+    import jax.numpy as jnp
+
+    def exchange(y, nsplit):
+        planes = jnp.stack([jnp.real(y), jnp.imag(y)])
+        planes = planes.astype(jnp.bfloat16)
+        wide = jax.lax.all_to_all(planes, 'dev', 2, 1, tiled=False)
+        return jax.lax.complex(wide[0], wide[1])
+    """, select=['NBK701'])
+    assert codes(fs) == ['NBK701']
+
+
 # ---------------------------------------------------------------------------
 # NBK702 — uncompensated narrow accumulation
 
@@ -152,6 +188,41 @@ def test_nbk702_scatter_add_accumulator_positive():
     assert codes(fs) == ['NBK702']
 
 
+def test_nbk702_two_sum_deposit_negative():
+    # the ops/paint.py bf16 replica idiom (ISSUE 13): each weight is
+    # split hi/lo by a two-sum (lo assigned from a Sub of the
+    # round-tripped hi) and both halves deposited narrow; the residual
+    # makes the narrow accumulation compensated — clean
+    fs = lint_str("""
+    import jax.numpy as jnp
+
+    def deposit(lin, w):
+        flat = jnp.zeros(64, jnp.bfloat16)
+        w32 = w.astype(jnp.float32)
+        hi = w32.astype(jnp.bfloat16)
+        lo = w32 - hi.astype(jnp.float32)
+        flat = flat.at[lin].add(hi)
+        flat = flat.at[lin].add(lo.astype(jnp.bfloat16))
+        return flat
+    """, select=['NBK702'])
+    assert codes(fs) == []
+
+
+def test_nbk702_narrow_deposit_no_residual_positive():
+    # same deposit WITHOUT the lo residual: uncompensated narrow
+    # scatter-accumulation — flagged
+    fs = lint_str("""
+    import jax.numpy as jnp
+
+    def deposit(lin, w):
+        flat = jnp.zeros(64, jnp.bfloat16)
+        hi = w.astype(jnp.bfloat16)
+        flat = flat.at[lin].add(hi)
+        return flat
+    """, select=['NBK702'])
+    assert codes(fs) == ['NBK702']
+
+
 # ---------------------------------------------------------------------------
 # NBK703 — mixed-dtype arithmetic promoting a mesh-sized operand
 
@@ -194,6 +265,22 @@ def test_nbk703_chunk_sized_narrow_negative():
         wb = w.astype(jnp.bfloat16)
         v32 = v.astype(jnp.float32)
         return wb * v32
+    """, select=['NBK703'])
+    assert codes(fs) == []
+
+
+def test_nbk703_readout_rewiden_first_negative():
+    # the pmesh._readout_impl contract (ISSUE 13): a bf16-stored field
+    # is re-widened ONCE at entry, so all downstream interpolation
+    # arithmetic is same-width f32 — no mesh-sized mixed promotion
+    fs = lint_str("""
+    import jax.numpy as jnp
+
+    def readout(pm, pos, w):
+        field = pm.paint(pos)
+        real = field.astype(jnp.float32)
+        w32 = w.astype(jnp.float32)
+        return real * w32
     """, select=['NBK703'])
     assert codes(fs) == []
 
