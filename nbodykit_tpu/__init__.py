@@ -93,6 +93,19 @@ _default_options = {
     # (e.g. '4x2') or a tuple; None picks the most nearly square
     # factorization of the device count (runtime.default_pencil_factor)
     'fft_pencil': None,
+    # rows per host chunk on the streaming ingestion path
+    # (nbodykit_tpu.ingest, docs/INGEST.md): the window each
+    # double-buffered device_put/paint step moves — the host never
+    # holds more than two windows. 'auto' consults the tune cache
+    # (keyed by the part-count shape class), falling back to 262144
+    'ingest_chunk_rows': 'auto',
+    # overlap H2D transfer of chunk i+1 with the paint of chunk i
+    # (the double buffer). False serializes transfer-then-paint —
+    # kept selectable for A/B measurement (bench --ingest)
+    'ingest_overlap': True,
+    # hard cap (bytes) on the on-device catalog cache per sub-mesh;
+    # 'auto'/None defers entirely to memory_plan pricing at admission
+    'ingest_cache_bytes': 'auto',
     # performance-database file for 'auto' option resolution and
     # nbodykit-tpu-tune (nbodykit_tpu.tune, docs/TUNE.md). None uses
     # the committed repo-root TUNE_CACHE.json; seeded from

@@ -641,6 +641,48 @@ def run_doctor(trace=None, root='.', self_check_only=False,
             else:
                 lines.append('serve        OK: %s' % desc)
 
+    if root is not None:
+        # ingestion posture: the latest committed ingest round.  The
+        # WARN condition is cache thrash — more evictions than hits
+        # means the catalog cache is churning instead of serving, so
+        # repeat requests re-pay ingestion (shrink the catalogs or
+        # grow the budget); a lost data_ref request fails like any
+        # other lost serve request would.
+        from .regress import ingest_summary
+        ing = ingest_summary(root)
+        if ing is None:
+            lines.append('ingest       SKIP: no ingest record in any '
+                         'committed bench round')
+        elif 'error' in ing:
+            warn.append('ingest')
+            lines.append('ingest       WARN: ingest summary '
+                         'unavailable (%s)' % ing['error'])
+        else:
+            desc = ('%s rows -> painted mesh at %s GB/s cold, %s GB/s '
+                    'cache-hit; overlap x%s vs serialized; served=%s '
+                    'from_cache=%s'
+                    % (ing.get('rows', '?'), ing.get('cold_gbs', '?'),
+                       ing.get('warm_gbs', '?'),
+                       ing.get('overlap_speedup', '?'),
+                       ing.get('serve_completed', '?'),
+                       ing.get('serve_cache_hits', '?')))
+            ev = ing.get('cache_evictions') or 0
+            hits = ing.get('cache_hits') or 0
+            if ing.get('serve_lost'):
+                fail.append('ingest')
+                lines.append('ingest       FAIL: %s data_ref '
+                             'request(s) lost without a structured '
+                             'verdict (%s)'
+                             % (ing['serve_lost'], desc))
+            elif ev > hits:
+                warn.append('ingest')
+                lines.append('ingest       WARN: cache thrash — %d '
+                             'eviction(s) vs %d hit(s); repeat '
+                             'requests are re-paying ingestion (%s)'
+                             % (ev, hits, desc))
+            else:
+                lines.append('ingest       OK: %s' % desc)
+
     verdict = 'FAIL (%s)' % ', '.join(fail) if fail else \
         ('WARN (%s)' % ', '.join(warn) if warn else 'OK')
     out.write('== nbodykit-tpu doctor ==\n')

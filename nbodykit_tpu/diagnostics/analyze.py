@@ -57,6 +57,10 @@ _PHASE_PREFIXES = (
     ('ckpt.', 'resilience'),
     # per-request serving spans (nbodykit_tpu.serve)
     ('serve.', 'serve'),
+    # streaming catalog ingestion (nbodykit_tpu.ingest): the H2D
+    # chunk pipeline's transfer time is a first-class phase — the
+    # paint it overlaps still bills to 'paint' (above)
+    ('ingest', 'ingest'),
 )
 
 

@@ -405,6 +405,48 @@ def serve_summary(root):
     return latest
 
 
+def ingest_summary(root):
+    """Ingestion posture for the round record: the latest committed
+    ``ingest*`` bench record (``bench.py --ingest``) reduced to the
+    headline throughput — GB/s from file to painted mesh, cold and
+    cache-hit, overlapped vs serialized — plus the cache ledger the
+    doctor's thrash verdict (evictions > hits) judges.  ``None`` when
+    no round carries an ingest record; never raises."""
+    latest = None
+    try:
+        for pattern in ROUND_GLOBS:
+            for path in sorted(glob.glob(os.path.join(root, pattern)),
+                               key=_round_key):
+                try:
+                    with open(path) as f:
+                        rec = json.load(f).get('parsed') or {}
+                except (OSError, ValueError):
+                    continue
+                metric = str(rec.get('metric', ''))
+                if not metric.startswith('ingest'):
+                    continue
+                latest = {
+                    'round': os.path.basename(path),
+                    'metric': metric,
+                    'rows': rec.get('rows'),
+                    'bytes': rec.get('bytes'),
+                    'chunk_rows': rec.get('chunk_rows'),
+                    'cold_gbs': rec.get('cold_gbs'),
+                    'warm_gbs': rec.get('warm_gbs'),
+                    'serial_gbs': rec.get('serial_gbs'),
+                    'overlap_speedup': rec.get('overlap_speedup'),
+                    'host_peak_bytes': rec.get('host_peak_bytes'),
+                    'cache_hits': rec.get('cache_hits'),
+                    'cache_evictions': rec.get('cache_evictions'),
+                    'serve_completed': rec.get('serve_completed'),
+                    'serve_cache_hits': rec.get('serve_cache_hits'),
+                    'serve_lost': rec.get('serve_lost'),
+                }
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+    return latest
+
+
 # winner-option posture -> the margin key the precision harness
 # records in PRECISION.json (tests/test_precision.py and the smoke
 # precision gate both write through write_precision_margins)
@@ -532,6 +574,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'resilience': resilience_summary(root, now=now),
         'fleet': fleet_summary(root, now=now),
         'serve': serve_summary(root),
+        'ingest': ingest_summary(root),
         'precision': precision_summary(root, now=now),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
@@ -559,8 +602,8 @@ def render_regress(history):
         fw = max(len(e['file']) for e in rounds)
         for e in rounds:
             v = e.get('value')
-            val = '%10.4f s' % v if isinstance(v, (int, float)) \
-                else '         --'
+            val = '%10.4f %s' % (v, e.get('unit') or 's') \
+                if isinstance(v, (int, float)) else '         --'
             line = '  %-*s  %-44s %s  %-10s' \
                 % (fw, e['file'], e.get('metric', '(no record)')[:44],
                    val, e.get('verdict', '?').upper())
@@ -631,6 +674,31 @@ def render_regress(history):
                  serve.get('lost', '?'),
                  ', faults injected at %s and survived'
                  % ', '.join(fpoints) if fpoints else ''))
+    ing = history.get('ingest')
+    if ing is not None:
+        if 'error' in ing:
+            w('  ingest: unavailable (%s)' % ing['error'])
+        else:
+            bits = []
+            if ing.get('overlap_speedup') is not None:
+                bits.append('overlap x%.2f vs serialized'
+                            % ing['overlap_speedup'])
+            if ing.get('serve_completed') is not None:
+                bits.append('%s data_ref request(s) served, %s from '
+                            'cache, %s lost'
+                            % (ing['serve_completed'],
+                               ing.get('serve_cache_hits', '?'),
+                               ing.get('serve_lost', '?')))
+            ev, hits = (ing.get('cache_evictions'),
+                        ing.get('cache_hits'))
+            if ev is not None and hits is not None and ev > hits:
+                bits.append('WARN — cache thrash: %d eviction(s) vs '
+                            '%d hit(s)' % (ev, hits))
+            w('  ingest: %s rows -> painted mesh at %s GB/s cold, '
+              '%s GB/s cache-hit%s'
+              % (ing.get('rows', '?'), ing.get('cold_gbs', '?'),
+                 ing.get('warm_gbs', '?'),
+                 ' — %s' % '; '.join(bits) if bits else ''))
     prec = history.get('precision')
     if prec is not None:
         if 'error' in prec:

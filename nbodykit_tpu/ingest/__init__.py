@@ -1,0 +1,37 @@
+"""The streaming sharded ingestion plane: catalogs as data.
+
+Real surveys arrive as files, not seeds.  This package turns a file
+path into a painted device mesh without ever materializing the catalog
+on any host:
+
+- :mod:`.rules` — the partition-rule tree mapping named catalog
+  columns onto PartitionSpecs of the live device mesh;
+- :mod:`.stream` — chunked reader -> double-buffered ``device_put``
+  -> paint, with ``ingest.*`` spans/counters, chunk-boundary
+  checkpoints, and the :class:`DataRef` request pointer;
+- :mod:`.cache` — the content-addressed on-device catalog cache
+  (sha256 over column bytes + partition layout, LRU eviction priced
+  through ``memory_plan``), so N requests against one survey pay
+  ingestion once.
+
+See docs/INGEST.md for the rule grammar, cache keying and the overlap
+model.
+"""
+
+from .cache import (CatalogCache, CatalogEntry, fold_digest,
+                    layout_token)
+from .rules import (DEFAULT_RULES, ROWS, make_shard_and_gather_fns,
+                    match_partition_rules, partition_specs,
+                    resolve_partition_spec)
+from .stream import (ArraySource, DataRef, IngestError, ingest_catalog,
+                     paint_cached, paint_chunks, probe_ref,
+                     resolve_chunk_rows)
+
+__all__ = [
+    'ArraySource', 'CatalogCache', 'CatalogEntry', 'DataRef',
+    'DEFAULT_RULES', 'IngestError', 'ROWS', 'fold_digest',
+    'ingest_catalog', 'layout_token', 'make_shard_and_gather_fns',
+    'match_partition_rules', 'paint_cached', 'paint_chunks',
+    'partition_specs', 'probe_ref', 'resolve_chunk_rows',
+    'resolve_partition_spec',
+]

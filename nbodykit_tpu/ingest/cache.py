@@ -1,0 +1,169 @@
+"""The content-addressed on-device catalog cache.
+
+N requests against one survey must pay ingestion ONCE: after a cold
+ingest the sharded column chunks stay resident on the device mesh,
+keyed by a content address — sha256 over the catalog's column bytes
+AND the partition layout (columns, dtypes, chunk_rows, device count,
+spec templates).  Two requests whose bytes or layout differ can never
+collide; two requests that agree get the same device arrays back and
+go straight to paint.
+
+Lookups are two-level, the git-index discipline:
+
+- the **fingerprint** (realpath, size, mtime_ns, columns, layout) is
+  the O(1) stat-cheap front door — a changed file bumps size/mtime
+  and misses;
+- the **content digest** is the entry's identity, computed
+  incrementally per chunk during the cold ingest (a Merkle fold over
+  per-chunk sha256s — resumable across chunk-boundary checkpoints),
+  so a hit never re-reads the file.
+
+Eviction is LRU, priced through :func:`nbodykit_tpu.pmesh.memory_plan`:
+the caller passes a ``fits(resident_bytes)`` predicate built from the
+incoming request's plan (``catalog_bytes=resident + incoming``), and
+the cache evicts least-recently-used entries until the predicate holds
+(or a hard ``budget_bytes`` cap is honored, whichever binds first).
+Counters: ``ingest.cache.hits`` / ``.misses`` / ``.evictions``;
+``ingest.cache.bytes`` gauges residency — the doctor WARNs on thrash
+(evictions > hits).
+"""
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+
+from ..diagnostics import counter, gauge
+
+
+def layout_token(columns, dtypes, chunk_rows, ndevices, templates):
+    """The canonical partition-layout string hashed into the content
+    address: what the device arrays LOOK like, independent of which
+    request asked."""
+    return json.dumps({
+        'columns': list(columns),
+        'dtypes': [str(d) for d in dtypes],
+        'chunk_rows': int(chunk_rows),
+        'ndevices': int(ndevices),
+        'specs': {k: list(map(str, v)) for k, v in
+                  sorted(templates.items())},
+    }, sort_keys=True)
+
+
+def fold_digest(layout, chunk_digests):
+    """The content address: sha256 over the layout token plus the
+    ordered per-chunk column-byte digests (Merkle fold — a resumed
+    ingest carries the completed chunks' digests in its checkpoint
+    and continues the fold without re-reading them)."""
+    h = hashlib.sha256(layout.encode())
+    for d in chunk_digests:
+        h.update(bytes.fromhex(d) if isinstance(d, str) else d)
+    return h.hexdigest()
+
+
+class CatalogEntry(object):
+    """One resident catalog: the sharded per-chunk device arrays plus
+    the identity that admitted them."""
+
+    __slots__ = ('digest', 'layout', 'chunks', 'nrows', 'nbytes',
+                 'chunk_rows')
+
+    def __init__(self, digest, layout, chunks, nrows, chunk_rows):
+        self.digest = digest
+        self.layout = layout
+        self.chunks = list(chunks)   # [(pos_dev, mass_dev, nvalid)]
+        self.nrows = int(nrows)
+        self.chunk_rows = int(chunk_rows)
+        self.nbytes = int(sum(
+            int(getattr(a, 'nbytes', 0)) + int(getattr(m, 'nbytes', 0))
+            for a, m, _ in self.chunks))
+
+
+class CatalogCache(object):
+    """LRU map fingerprint -> :class:`CatalogEntry` (device-resident).
+
+    ``budget_bytes`` is an optional hard cap on summed entry bytes;
+    the per-request ``fits`` predicate passed to :meth:`ensure_room`
+    carries the memory_plan pricing.  Thread-safe: serve workers share
+    one cache per sub-mesh.
+    """
+
+    def __init__(self, budget_bytes=None):
+        self.budget_bytes = None if budget_bytes is None \
+            else int(budget_bytes)
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @property
+    def resident_bytes(self):
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def lookup(self, fingerprint):
+        """The resident entry for a fingerprint (LRU-touched), or
+        None.  Every call counts as a hit or a miss."""
+        with self._lock:
+            entry = self._entries.get(fingerprint)
+            if entry is not None:
+                self._entries.move_to_end(fingerprint)
+                self.hits += 1
+            else:
+                self.misses += 1
+        counter('ingest.cache.hits' if entry is not None
+                else 'ingest.cache.misses').add(1)
+        return entry
+
+    def ensure_room(self, incoming_bytes, fits=None):
+        """Evict LRU entries until ``incoming_bytes`` more fit: under
+        the hard cap (when set) AND under ``fits(resident + incoming)``
+        (when given — the memory_plan predicate).  Returns the number
+        evicted.  An empty cache that still does not fit is the
+        caller's admission problem, not an eviction loop."""
+        evicted = 0
+        with self._lock:
+            while self._entries:
+                resident = sum(e.nbytes for e in self._entries.values())
+                over_cap = (self.budget_bytes is not None
+                            and resident + incoming_bytes
+                            > self.budget_bytes)
+                over_plan = (fits is not None
+                             and not fits(resident + incoming_bytes))
+                if not (over_cap or over_plan):
+                    break
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                evicted += 1
+        if evicted:
+            counter('ingest.cache.evictions').add(evicted)
+            gauge('ingest.cache.bytes').set(self.resident_bytes)
+        return evicted
+
+    def put(self, fingerprint, entry, fits=None):
+        """Insert (evicting for room first); returns ``entry``."""
+        self.ensure_room(entry.nbytes, fits=fits)
+        with self._lock:
+            self._entries[fingerprint] = entry
+            self._entries.move_to_end(fingerprint)
+            resident = sum(e.nbytes for e in self._entries.values())
+        gauge('ingest.cache.bytes').set(resident)
+        return entry
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+        gauge('ingest.cache.bytes').set(0)
+
+    def stats(self):
+        with self._lock:
+            return {'entries': len(self._entries),
+                    'resident_bytes': sum(e.nbytes for e in
+                                          self._entries.values()),
+                    'hits': self.hits, 'misses': self.misses,
+                    'evictions': self.evictions}

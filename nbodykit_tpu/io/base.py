@@ -67,6 +67,31 @@ class FileType(object):
     def keys(self):
         return self.columns
 
+    def row_range(self, rank, nranks):
+        """This rank's exact ``[start, stop)`` row span under the
+        balanced integer partition ``start = size*rank // nranks``.
+        Spans tile the file exactly — no overlap, no dropped tail —
+        whatever ``size % nranks`` is (the uneven-tail bug class the
+        ingest property test pins across every reader)."""
+        if not (0 <= rank < nranks):
+            raise ValueError("rank %d not in [0, %d)" % (rank, nranks))
+        size = int(self.size)
+        return size * rank // nranks, size * (rank + 1) // nranks
+
+    def read_chunks(self, columns, chunk_rows, rank=0, nranks=1):
+        """Yield this rank's rows as structured-array chunks of at
+        most ``chunk_rows`` — the uniform streaming interface every
+        reader inherits (the ingest plane's bounded-host-RAM source).
+        The final chunk carries the uneven tail; chunks are never
+        padded here (the device pipeline pads to the mesh size)."""
+        chunk_rows = int(chunk_rows)
+        if chunk_rows < 1:
+            raise ValueError("chunk_rows must be >= 1, got %d"
+                             % chunk_rows)
+        start, stop = self.row_range(rank, nranks)
+        for s in range(start, stop, chunk_rows):
+            yield self.read(columns, s, min(s + chunk_rows, stop))
+
     def _empty(self, columns, n):
         dt = np.dtype([(c, self.dtype[c]) for c in columns])
         return np.empty(n, dtype=dt)

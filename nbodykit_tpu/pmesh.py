@@ -709,7 +709,8 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
                 paint_method='scatter', paint_chunk=None,
                 paint_streams=None, hbm_bytes=16e9, exchange='counted',
                 exchange_imbalance=1.5, fft_decomp='slab',
-                fft_pencil=None):
+                fft_pencil=None, ingest_chunk_rows=None,
+                catalog_bytes=None):
     """Estimated peak per-device HBM for the FFTPower pipeline
     (paint -> rFFT -> |delta_k|^2 -> chunked binning) — the arithmetic
     behind chunk-size choices and the BASELINE.md scale claims
@@ -747,6 +748,17 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     "Halving the bytes").  The report's ``mesh_dtype`` /
     ``mesh_itemsize`` keys record what was priced so admission
     rejections can quote it.
+
+    ``ingest_chunk_rows`` prices the streaming-ingestion pipeline of a
+    ``data_ref`` request (nbodykit_tpu.ingest): the resident sharded
+    catalog replaces the synthetic ``positions`` term (positions PLUS
+    the mass column, 4 compute words per row), and the double-buffered
+    H2D staging adds two in-flight padded chunks during the paint
+    phase.  ``catalog_bytes`` (total per-DEVICE resident catalog-cache
+    bytes, this entry included) overrides the single-entry default so
+    admission can price an eviction decision: the cache's
+    ``fits(resident)`` predicate is exactly this plan re-asked at a
+    candidate residency.
     """
     N = _triplet(Nmesh, 'i8')
     ndev = max(int(ndevices), 1)
@@ -779,6 +791,22 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
                         'fft_pencil_buffers': PENCIL_BUFFERS,
                         'fft_pencil_pad': float(ncp) / float(nc)}
     pos_b = 3 * citem * npart / ndev
+    ingest_extra = {}
+    ingest_buf = 0.0
+    if ingest_chunk_rows is not None:
+        # the resident catalog entry (pos + mass, 4 compute words per
+        # row, row-sharded) IS this pipeline's particle storage; a
+        # caller-supplied total residency (other cache entries
+        # included) replaces the single-entry default
+        entry_b = 4 * citem * npart / ndev
+        pos_b = float(catalog_bytes) / ndev \
+            if catalog_bytes is not None else entry_b
+        pos_b = max(pos_b, entry_b)
+        # two in-flight padded host chunks (double buffer) staged on
+        # device during the streaming paint
+        ingest_buf = 2 * 4 * citem * float(ingest_chunk_rows) / ndev
+        ingest_extra = {'catalog_bytes': pos_b,
+                        'ingest_chunk_buffers': ingest_buf}
     if paint_chunk is None:
         chunk = _global_options['paint_chunk_size']
         if isinstance(chunk, bool) or not isinstance(chunk,
@@ -863,10 +891,12 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
         'mesh_itemsize': item,
     }
     phases.update(pencil_extra)
-    # paint phase: field + positions + temporaries + exchange;
+    phases.update(ingest_extra)
+    # paint phase: field + positions + temporaries + exchange (+ the
+    # in-flight ingest staging chunks on the streaming path);
     # fft phase: real + complex + workspace (positions still resident
     # unless donated); binning adds only O(chunk) slabs
-    peak = max(real + pos_b + paint_tmp + exch,
+    peak = max(real + pos_b + paint_tmp + exch + ingest_buf,
                real + cplx + fft_ws + pos_b,
                cplx + p3 + pos_b)
     phases['peak_bytes'] = peak

@@ -6,7 +6,10 @@ operating regime of a survey-scale TPU pod: a persistent,
 admission-controlled, multi-tenant analysis server.
 
 - :mod:`.request` — the declarative :class:`AnalysisRequest` (what to
-  compute + deadline + priority; a few hundred bytes, no arrays).
+  compute + deadline + priority; a few hundred bytes, no arrays —
+  real-survey requests point at their catalog with ``data_ref``
+  instead of ``seed`` and the ingestion plane
+  (:mod:`nbodykit_tpu.ingest`) streams it onto the sub-mesh).
 - :mod:`.admission` — every request priced through
   :func:`~nbodykit_tpu.pmesh.memory_plan` against the sub-mesh HBM
   budget BEFORE scheduling: admit, degrade down the request-scoped

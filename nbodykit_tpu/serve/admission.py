@@ -68,7 +68,8 @@ class AdmissionDecision(object):
             ' %s' % self.reason.get('code') if self.reason else '')
 
 
-def _plan(request, ndevices, hbm_bytes, paint_chunk=None):
+def _plan(request, ndevices, hbm_bytes, paint_chunk=None,
+          catalog_bytes=None):
     method = request.paint_method
     if method in (None, 'auto'):
         # price what would actually run: the tune-cache resolution for
@@ -80,11 +81,34 @@ def _plan(request, ndevices, hbm_bytes, paint_chunk=None):
                                                      'scatter')
         if method == 'auto':
             method = 'scatter'
+    chunk_rows = None
+    if getattr(request, 'data_ref', None) is not None:
+        # a data_ref request streams+paints+transforms jointly: price
+        # the resident catalog and the double-buffered staging chunks
+        # alongside the mesh pipeline
+        from ..ingest.stream import resolve_chunk_rows
+        chunk_rows = resolve_chunk_rows(npart=request.npart,
+                                        nproc=ndevices)
     return memory_plan(request.nmesh, request.npart,
                        ndevices=ndevices, dtype=request.dtype,
                        resampler=request.resampler,
                        paint_method=method, paint_chunk=paint_chunk,
-                       hbm_bytes=hbm_bytes)
+                       hbm_bytes=hbm_bytes,
+                       ingest_chunk_rows=chunk_rows,
+                       catalog_bytes=catalog_bytes)
+
+
+def catalog_fits_fn(request, ndevices=1, hbm_bytes=16e9):
+    """The catalog-cache eviction predicate for one admitted data_ref
+    request: ``fits(total_resident_bytes)`` is this request's
+    admission plan re-priced at a candidate cache residency — the
+    scheduler hands it to :meth:`CatalogCache.ensure_room` so LRU
+    entries fall out exactly when memory_plan says the joint
+    ingestion+paint+FFT peak would not fit beside them."""
+    def fits(resident_bytes):
+        return bool(_plan(request, ndevices, hbm_bytes,
+                          catalog_bytes=resident_bytes)['fits'])
+    return fits
 
 
 def admit(request, ndevices=1, hbm_bytes=16e9):
@@ -97,6 +121,24 @@ def admit(request, ndevices=1, hbm_bytes=16e9):
     ``code='over_budget'`` quoting every rung it tried.
     """
     ndevices = max(int(ndevices), 1)
+    if getattr(request, 'data_ref', None) is not None:
+        # open the ref NOW: an unreadable path must reject with a
+        # structured verdict at admission, never fail a worker later —
+        # and the file's row count becomes the npart everything else
+        # (pricing, shape class, program key) is judged by
+        from ..ingest.stream import IngestError, probe_ref
+        try:
+            info = probe_ref(request.data_ref)
+        except IngestError as e:
+            return AdmissionDecision(REJECT, request.request_id,
+                                     reason=e.to_reason())
+        if info['nrows'] < 1:
+            return AdmissionDecision(REJECT, request.request_id,
+                                     reason={
+                'code': 'unreadable_data_ref',
+                'path': request.data_ref.get('path'),
+                'detail': 'catalog has zero rows'})
+        request.npart = int(info['nrows'])
     if request.nmesh % ndevices:
         return AdmissionDecision(REJECT, request.request_id, reason={
             'code': 'indivisible', 'nmesh': request.nmesh,

@@ -102,6 +102,21 @@ def _uniform_pos(seed, npart, L):
                               jnp.float32, 0.0, L)
 
 
+def _build_data(request, pm):
+    """The (painted field -> (k, P, nmodes)) stage of a ``data_ref``
+    program.  The paint itself is NOT in here: streaming ingestion is
+    eager by construction (chunks arrive over time), so the jitted
+    boundary starts at the finished field — one warm executable per
+    shape serves every survey."""
+    npart = request.npart
+    resampler = request.resampler
+
+    def from_field(field):
+        c = pm.r2c(field / (float(npart) / pm.Ntot))
+        return _binned_power(pm, c, resampler, npart)
+    return from_field
+
+
 def _build_single(request, pm):
     """The single-realization (seed -> (x, y, nmodes)) function for
     one algorithm on one ParticleMesh."""
@@ -171,7 +186,8 @@ class Program(object):
     multi-device programs take one seed per launch.
     """
 
-    __slots__ = ('key', 'label', 'mesh', 'batchable', '_fn', '_device')
+    __slots__ = ('key', 'label', 'mesh', 'batchable', '_fn', '_device',
+                 'data', '_pm', '_resampler')
 
     def __init__(self, request, mesh):
         import jax
@@ -179,6 +195,23 @@ class Program(object):
         self.key = request.program_key(mesh_size(mesh))
         self.label = program_label(request)
         self.mesh = mesh
+        self.data = getattr(request, 'data_ref', None) is not None
+        self._pm = None
+        self._resampler = request.resampler
+        if self.data:
+            # data programs are never vmap-batched: their input is a
+            # streamed catalog, not a seed array.  The pm is kept — the
+            # eager ingest paints on it; only field -> spectrum is jit.
+            self.batchable = False
+            self._device = None
+            pm = ParticleMesh(request.nmesh, BOX_SIZE, request.dtype,
+                              comm=mesh)
+            self._pm = pm
+            # memoized-by-ProgramCache lifetime (see below)
+            # nbkl: disable=NBK202
+            self._fn = instrumented_jit(_build_data(request, pm),
+                                        label=self.label)
+            return
         self.batchable = mesh_size(mesh) == 1
         if self.batchable:
             # comm-less plain-ops form — the ONLY form vmap can batch
@@ -228,6 +261,23 @@ class Program(object):
                 x, y, nm = self._fn(jnp.uint32(s))
                 out.append(tuple(np.asarray(v) for v in (x, y, nm)))
         return out
+
+    def run_data(self, ref, cache=None, fits=None, overlap=None):
+        """Execute a ``data_ref`` program: stream (or cache-hit) the
+        catalog onto this sub-mesh, then run the warm field->spectrum
+        executable.  Returns ``([(x, y, nmodes)], ingest_stats)`` —
+        the stats carry cache_hit / bytes / seconds so the server can
+        expose ingestion throughput per request."""
+        import numpy as np
+        from ..ingest.stream import ingest_catalog
+        from ..parallel.runtime import use_mesh
+        with use_mesh(self.mesh):
+            field, _, stats = ingest_catalog(
+                ref, self._pm, resampler=self._resampler, cache=cache,
+                fits=fits, overlap=overlap)
+            x, y, nm = self._fn(field)
+            out = tuple(np.asarray(v) for v in (x, y, nm))
+        return [out], stats
 
 
 class ProgramCache(object):
@@ -291,8 +341,15 @@ class ProgramCache(object):
 def affinity(request, ndevices, n_workers):
     """The worker whose cache this request's program warms: stable
     across the request stream (hash of the program key), so identical
-    shapes land where their executable already lives."""
-    return hash(request.program_key(ndevices)) % max(n_workers, 1)
+    shapes land where their executable already lives.  ``data_ref``
+    requests salt the hash with the catalog path: repeat requests
+    against one survey land on the worker whose CatalogCache already
+    holds it (the cache-hit-to-paint route), while distinct surveys of
+    the same shape spread."""
+    key = request.program_key(ndevices)
+    if getattr(request, 'data_ref', None) is not None:
+        key = key + (request.data_ref.get('path'),)
+    return hash(key) % max(n_workers, 1)
 
 
 def rank(ticket):

@@ -163,6 +163,18 @@ class AnalysisServer(object):
         self.checkpoint = checkpoint
         self.retry = retry
         self.programs = ProgramCache()
+        # one content-addressed catalog cache per sub-mesh worker:
+        # repeat data_ref requests against a survey route (via the
+        # path-salted affinity) to the worker already holding it.
+        # 'ingest_cache_bytes' is an optional hard cap; the per-request
+        # memory_plan predicate (admission.catalog_fits_fn) prices
+        # eviction either way.
+        from .. import _global_options
+        from ..ingest.cache import CatalogCache
+        _cb = _global_options['ingest_cache_bytes']
+        _cb = int(_cb) if isinstance(_cb, (int, float)) \
+            and not isinstance(_cb, bool) else None
+        self.catalogs = [CatalogCache(_cb) for _ in self.meshes]
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -358,11 +370,23 @@ class AnalysisServer(object):
                 live.append(t)
         self._pending = live
 
+    # How long a data_ref ticket is reserved for its affinity worker
+    # before any idle worker may steal it.  A steal pays a full
+    # re-ingest onto a cold CatalogCache, so locality is worth a short
+    # wait — but only a short one: a wedged affinity worker must not
+    # strand the request (deadline eviction is not a placement policy).
+    DATA_STEAL_GRACE_S = 1.0
+
     def _pick_locked(self, wi, now):
         """Best ticket for worker ``wi``: its own affinity first, else
-        steal the globally best-ranked one."""
+        steal the globally best-ranked one.  data_ref tickets resist
+        stealing for ``DATA_STEAL_GRACE_S`` — their catalog may be
+        resident in the affinity worker's cache."""
         mine = [t for t in self._pending if t.affinity == wi]
-        pool = mine or self._pending
+        pool = mine or [t for t in self._pending
+                        if t.request.data_ref is None
+                        or now - t.submitted_at
+                        >= self.DATA_STEAL_GRACE_S]
         if not pool:
             return None
         best = min(pool, key=rank)
@@ -370,8 +394,11 @@ class AnalysisServer(object):
         return best
 
     def _batchable(self, ticket):
+        # data_ref requests never batch: their input is a streamed
+        # catalog, not a seed vmap can widen over
         return (self.ndevices == 1
                 and ticket.request.algorithm == 'FFTPower'
+                and ticket.request.data_ref is None
                 and not ticket.decision.options)
 
     def _collect_locked(self, leader, opened_at):
@@ -442,8 +469,11 @@ class AnalysisServer(object):
                          checkpoint=self.checkpoint)
         seeds = [t.request.seed for t in group]
         rid = req.request_id
+        ingest_stats = {}
 
         def work():
+            if req.data_ref is not None:
+                return work_data()
             got = sup.resume(rid, validate=lambda s:
                              s.get('seeds') == list(seeds))
             if got is not None:
@@ -465,6 +495,38 @@ class AnalysisServer(object):
                              'nm': np.array([o[2] for o in out])})
             # the post-work fault point: a kill injected here lands
             # AFTER the checkpoint, so the retry resumes, not recomputes
+            fault_point('serve.request.work')
+            return out
+
+        def work_data():
+            # the streamed-catalog path: never batched (group is just
+            # the leader), cache-hit routed straight to paint, evicting
+            # under this request's own memory_plan predicate
+            got = sup.resume(rid, validate=lambda s:
+                             s.get('data_path')
+                             == req.data_ref.get('path'))
+            if got is not None:
+                state, arrays = got
+                ingest_stats.update(state.get('ingest') or {})
+                return [(arrays['x'][0], arrays['y'][0],
+                         arrays['nm'][0])]
+            from .admission import catalog_fits_fn
+            fits = catalog_fits_fn(req, ndevices=self.ndevices,
+                                   hbm_bytes=self.hbm_bytes)
+            counter('serve.data_requests').add(1)
+            with nbodykit_tpu.option_scope(**opts):
+                prog = self.programs.get(req, mesh, wi, opts=opts)
+                out, stats = prog.run_data(req.data_ref,
+                                           cache=self.catalogs[wi],
+                                           fits=fits)
+            ingest_stats.update(stats)
+            import numpy as np
+            sup.save(rid, {'data_path': req.data_ref.get('path'),
+                           'ingest': {k: v for k, v in stats.items()
+                                      if not isinstance(v, bytes)}},
+                     arrays={'x': np.array([o[0] for o in out]),
+                             'y': np.array([o[1] for o in out]),
+                             'nm': np.array([o[2] for o in out])})
             fault_point('serve.request.work')
             return out
 
@@ -493,10 +555,16 @@ class AnalysisServer(object):
         if sup.events:
             counter('serve.fault_degraded').add(1)
         done_at = time.monotonic()
+        events = list(sup.events)
+        if ingest_stats:
+            # the per-request ingestion record (cache_hit, bytes,
+            # seconds, chunk_rows, host peak) rides on the result as
+            # an event — bench --ingest and the doctor read it there
+            events.append(dict(ingest_stats, kind='ingest'))
         for t, (x, y, nm) in zip(group, out):
             self._finish(t, RequestResult(
                 t.request.request_id, COMPLETED, x=x, y=y, nmodes=nm,
-                latency_s=done_at - t.submitted_at, events=sup.events,
+                latency_s=done_at - t.submitted_at, events=events,
                 options=opts, admit_options=t.decision.options,
                 batch_size=len(group),
                 algorithm=t.request.algorithm,
@@ -544,6 +612,13 @@ class AnalysisServer(object):
         preempted = sum(
             1 for r in results
             if (r.reason or {}).get('code') == 'preempted')
+        ingest_events = [e for r in results for e in r.events
+                         if e.get('kind') == 'ingest']
+        cat = {'entries': 0, 'resident_bytes': 0, 'hits': 0,
+               'misses': 0, 'evictions': 0}
+        for c in self.catalogs:
+            for k, v in c.stats().items():
+                cat[k] += v
         return {
             'submitted': submitted,
             'resolved': len(results),
@@ -565,6 +640,17 @@ class AnalysisServer(object):
             'workers': len(self.meshes),
             'ndevices_per_worker': self.ndevices,
             'programs': len(self.programs),
+            # the ingestion posture: how many completed requests
+            # streamed a catalog, how many of those were served from
+            # the on-device cache, and the fleet-wide cache counters
+            # (the doctor's thrash verdict reads evictions vs hits)
+            'ingest_requests': len(ingest_events),
+            'ingest_cache_hits': sum(
+                1 for e in ingest_events if e.get('cache_hit')),
+            'ingest_gb': round(sum(
+                float(e.get('bytes') or 0)
+                for e in ingest_events) / 1e9, 6),
+            'ingest_cache': cat,
             'by_class': {k: {'n': len(v),
                              'p50_s': self._pctile(v, 0.50),
                              'p99_s': self._pctile(v, 0.99)}

@@ -3,8 +3,8 @@
     nbodykit-tpu-tune                         (== python -m nbodykit_tpu.tune)
         Run the default trial plan on the current backend (paint at
         two shape classes, the FFT chunk ladder, the exchange slack
-        when a multi-device mesh is up) and commit the winners to
-        TUNE_CACHE.json.
+        when a multi-device mesh is up, the ingest chunk-rows ladder)
+        and commit the winners to TUNE_CACHE.json.
 
     nbodykit-tpu-tune --dry-run
         Print the deterministic trial plan (cache keys + candidates)
@@ -15,7 +15,7 @@
         Schema-check the committed cache and print its posture
         summary; exit 1 on a malformed file (the smoke gate).
 
-    Options: --ops paint,fft,exchange · --paint-shapes 64x1e4,128x1e5
+    Options: --ops paint,fft,exchange,ingest · --paint-shapes 64x1e4,128x1e5
     · --fft-nmesh 64,128 · --pencil PXxPY (fft decomp factorization)
     · --reps N · --cache PATH · --devices N (CPU: force N virtual
     devices and tune on that mesh).
@@ -84,6 +84,13 @@ def _contexts(args, spaces, nproc):
         for _, npart in _parse_paint_shapes(args.paint_shapes)[-1:]:
             pairs.append((spaces['exchange'],
                           {'npart': npart, 'dtype': 'f4', 'seed': 7}))
+    if 'ingest' in ops:
+        # the streaming window ladder, one entry per part-count class
+        # (the knob is keyed by npart alone — shape_class(npart=...))
+        for nmesh, npart in _parse_paint_shapes(args.paint_shapes):
+            pairs.append((spaces['ingest'],
+                          {'nmesh': nmesh, 'npart': npart,
+                           'dtype': 'f4', 'seed': 7}))
     return pairs
 
 
@@ -91,7 +98,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='nbodykit-tpu-tune', description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument('--ops', default='paint,fft,exchange',
+    ap.add_argument('--ops', default='paint,fft,exchange,ingest',
                     help='comma list of ops to tune (default: all)')
     ap.add_argument('--paint-shapes', default='64x1e4,128x1e5',
                     help="paint trial shapes as NMESHxNPART, comma-"

@@ -39,7 +39,7 @@ TUNABLE_OPTIONS = ('paint_method', 'paint_order', 'paint_deposit',
                    'paint_chunk_size', 'paint_bucket_slack',
                    'paint_streams', 'fft_chunk_bytes', 'fft_decomp',
                    'fft_pencil', 'exchange_slack', 'mesh_dtype',
-                   'a2a_compress')
+                   'a2a_compress', 'ingest_chunk_rows')
 
 STALE_DAYS = 30.0
 

@@ -36,6 +36,7 @@ FALLBACKS = {
     'exchange_slack': 1.05,
     'mesh_dtype': 'f4',            # cold cache: full-width mesh storage
     'a2a_compress': 'none',        # cold cache: uncompressed payloads
+    'ingest_chunk_rows': 262144,   # cold cache: the streaming window
 }
 
 
@@ -228,6 +229,25 @@ def resolve_exchange_slack(npart=None, nproc=1):
                             FALLBACKS['exchange_slack']))
 
 
+def resolve_ingest_chunk_rows(npart=None, nproc=1):
+    """Concrete streaming window for
+    ``set_options(ingest_chunk_rows='auto')``: the cache winner for
+    the nearest measured part-count class (the ``ingest`` op raced by
+    ``nbodykit-tpu-tune``), else 262144 rows — the cold-cache default
+    equal to pre-tuner behavior."""
+    v = _current('ingest_chunk_rows')
+    if not isinstance(v, bool) and isinstance(v, (int, float)):
+        return max(int(v), 1)
+    winner, _ = _consult('ingest',
+                         shape_class(npart=npart) if npart
+                         else 'part1e0', 'f4', nproc)
+    rows = winner.get('ingest_chunk_rows',
+                      FALLBACKS['ingest_chunk_rows'])
+    if isinstance(rows, bool) or not isinstance(rows, (int, float)):
+        rows = FALLBACKS['ingest_chunk_rows']
+    return max(int(rows), 1)
+
+
 def effective_int_option(option):
     """A concrete integer for a possibly-``'auto'`` option — the value
     the resilience ladder halves from
@@ -281,5 +301,12 @@ def tuned_snapshot(nmesh=None, npart=None, dtype='f4', nproc=1):
             shape=(nmesh,) * 3 if nmesh else None, dtype=dtype,
             nproc=nproc,
             mesh_shape=pxpy if decomp == 'pencil' else None),
+        # the streaming-ingestion window this measurement ran with
+        # (ISSUE 14: ingest GB/s numbers must be attributable)
+        'ingest_chunk_rows': resolve_ingest_chunk_rows(npart=npart,
+                                                       nproc=nproc),
+        'ingest_source': (
+            'auto' if _current('ingest_chunk_rows') == 'auto'
+            else 'explicit'),
         'cache': TuneCache().path,
     }

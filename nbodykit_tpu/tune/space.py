@@ -347,7 +347,57 @@ def exchange_space():
                        _exchange_candidates, _exchange_runner)
 
 
+# ---------------------------------------------------------------------------
+# ingest
+
+def _ingest_candidates(ctx):
+    # the chunk-rows ladder: windows small enough to keep two host
+    # buffers tiny, large enough to amortize per-chunk dispatch.  The
+    # ladder is clipped to the trial's particle count (a window larger
+    # than the catalog degenerates to whole-load and measures nothing),
+    # keyed by the part-count shape class so a 1e6-row winner never
+    # answers a 1e9-row question.
+    npart = int(ctx['npart'])
+    cands = []
+    for rows in (32768, 65536, 131072, 262144, 524288, 1048576):
+        if rows >= 2 * npart and cands:
+            break
+        cands.append(Candidate('rows%dk' % (rows // 1024),
+                               {'ingest_chunk_rows': rows}))
+    return cands
+
+
+def _ingest_runner(ctx):
+    # stream a deterministic in-memory catalog (the same rows every
+    # candidate) through the full chunk pipeline — rule-tree sharding,
+    # padded device_put, overlapped paint — on the current mesh; the
+    # candidate's ingest_chunk_rows is read inside ingest_catalog
+    import numpy as np
+
+    from ..ingest.stream import ArraySource, ingest_catalog
+    from ..pmesh import ParticleMesh
+    box = float(ctx.get('box', 1000.0))
+    rng = np.random.RandomState(int(ctx.get('seed', 7)))
+    pos = rng.uniform(0.0, box, size=(int(ctx['npart']), 3)) \
+        .astype('f4')
+    src = ArraySource({'Position': pos})
+    from ..parallel.runtime import CurrentMesh
+    pm = ParticleMesh(Nmesh=int(ctx.get('nmesh', 64)), BoxSize=box,
+                      dtype=ctx.get('dtype', 'f4'),
+                      comm=CurrentMesh.resolve(None))
+
+    def once():
+        field, _, _ = ingest_catalog(src, pm)
+        return _sync(field)
+    return once
+
+
+def ingest_space():
+    return SearchSpace('ingest', ('ingest_chunk_rows',),
+                       _ingest_candidates, _ingest_runner)
+
+
 def default_spaces():
     """``{op: SearchSpace}`` of every built-in space."""
     return {'paint': paint_space(), 'fft': fft_space(),
-            'exchange': exchange_space()}
+            'exchange': exchange_space(), 'ingest': ingest_space()}

@@ -270,6 +270,52 @@ print('serve gate OK: %(completed)d/%(submitted)d completed, '
       'retried=%(retried)d lost=%(lost)d p99=%(p99_s).3fs' % rec)
 EOF
 
+# ingestion plane gate (docs/INGEST.md): a small on-disk catalog is
+# served twice via data_ref on a 2-device sub-mesh — both requests
+# complete with bit-equal spectra and the second rides the worker's
+# content-addressed CatalogCache (ingestion paid once, nothing lost)
+echo "== ingest data_ref gate (2 requests, 1 cache hit) =="
+python - "$SMOKE_TMP" <<'EOF'
+import os, sys
+import numpy as np
+from nbodykit_tpu._jax_compat import set_cpu_devices
+set_cpu_devices(2)
+import jax
+jax.config.update('jax_enable_x64', True)
+import nbodykit_tpu
+from nbodykit_tpu.serve import COMPLETED, AnalysisRequest, AnalysisServer
+path = os.path.join(sys.argv[1], 'smoke_catalog.bin')
+np.random.RandomState(11).uniform(
+    0.0, 100.0, (2048, 3)).astype('f4').tofile(path)
+ref = {'path': path, 'format': 'binary',
+       'columns': {'Position': 'Position'},
+       'options': {'dtype': [('Position', ('f4', 3))]}}
+with nbodykit_tpu.set_options(ingest_chunk_rows=1024), \
+        AnalysisServer(per_task=2, max_queue=4) as srv:
+    r1 = srv.wait(srv.submit(AnalysisRequest(
+        nmesh=32, data_ref=ref, deadline_s=600.0)))
+    r2 = srv.wait(srv.submit(AnalysisRequest(
+        nmesh=32, data_ref=ref, deadline_s=600.0)))
+    summary = srv.summary()
+assert r1.status == COMPLETED and r2.status == COMPLETED, (r1, r2)
+np.testing.assert_array_equal(np.asarray(r1.y), np.asarray(r2.y))
+assert summary['ingest_requests'] == 2, summary
+assert summary['ingest_cache_hits'] == 1, summary
+assert summary['lost'] == 0, summary
+print('ingest gate OK: 2 data_ref requests completed, 1 cache hit, '
+      'bit-equal P(k), lost=0')
+EOF
+
+# the rule-tree-produced PartitionSpecs cross shard_map boundaries in
+# the paint path; the sharding-flow analyses must stay clean over the
+# whole surface with nothing new and nothing grandfathered (the
+# NBK6 zero-budget policy from the stats gate, enforced standalone so
+# an ingest-plane spec bug fails even if run outside full smoke)
+echo "== ingest sharding-flow gate (NBK6xx clean) =="
+python -m nbodykit_tpu.lint --select NBK6 nbodykit_tpu/ bench.py
+python -m nbodykit_tpu.lint --shard-report nbodykit_tpu/ingest/ \
+    nbodykit_tpu/pmesh.py
+
 # fleet survivability gate (docs/RESILIENCE.md): a 2-process gloo
 # fleet has rank 1 SIGKILLed entering rep 2 — rank 0's live monitor
 # must detect the dead peer and exit DEAD_RANK_EXIT (76) instead of
@@ -338,6 +384,7 @@ python -m pytest \
     tests/test_fftpower.py \
     tests/test_counted_exchange.py \
     tests/test_radix.py \
+    tests/test_ingest.py \
     -q -m 'not slow' -p no:cacheprovider ${SMOKE_PYTEST_ARGS:-}
 
 echo "smoke OK"

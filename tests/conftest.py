@@ -78,6 +78,15 @@ _SLOW = {
     "test_fof.py::test_fof_two_well_separated_clusters",
     "test_groups.py::test_fibercollisions_isolated",
     "test_groups.py::test_fibercollisions_pair",
+    "test_ingest.py::test_cache_fits_predicate_prices_eviction",
+    "test_ingest.py::test_cache_hit_bit_identical_and_zero_reads",
+    "test_ingest.py::test_cache_misses_when_bytes_change",
+    "test_ingest.py::test_eviction_under_shrunken_budget_reingests",
+    "test_ingest.py::test_fault_mid_stream_resumes_without_repainting",
+    "test_ingest.py::test_host_never_holds_the_catalog",
+    "test_ingest.py::test_overlap_and_serial_paths_bit_identical",
+    "test_ingest.py::test_resume_refuses_changed_catalog",
+    "test_ingest.py::test_streamed_bit_identical_to_whole_load",
     "test_groups.py::test_fibercollisions_triplet_chain",
     "test_io.py::test_mesh_save_and_bigfile_mesh",
     "test_lognormal.py::test_lognormal_columns",

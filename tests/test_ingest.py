@@ -1,0 +1,545 @@
+"""Ingestion-plane tests (nbodykit_tpu.ingest, docs/INGEST.md).
+
+The contracts under test, in order of importance:
+
+- **bit-identity**: the painted mesh is defined by the chunked deposit
+  order, and every route to it — cold streamed (overlap on or off),
+  cache-hit replay, whole-resident catalog pushed through
+  ``paint_chunks`` — produces the SAME bits;
+- **bounded host**: the high-water mark of host-resident chunk bytes
+  never approaches the catalog size (the whole point of streaming);
+- **content addressing**: same bytes hit, changed bytes miss, eviction
+  under a shrunken budget re-ingests correctly;
+- **exact partition**: every reader's ``row_range``/``read_chunks``
+  covers each row exactly once across ranks, uneven tails included;
+- **resume**: a fault mid-stream + a CheckpointStore resumes by
+  re-transferring (never re-painting) finished chunks, and a catalog
+  that changed under the checkpoint is refused;
+- **serving**: ``data_ref`` requests complete end-to-end, repeat
+  requests ride the worker's on-device cache, unreadable paths get a
+  structured reject.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import nbodykit_tpu
+from nbodykit_tpu import io as nio
+from nbodykit_tpu.ingest import (ArraySource, CatalogCache, DataRef,
+                                 IngestError, ingest_catalog,
+                                 match_partition_rules, paint_cached,
+                                 paint_chunks, probe_ref,
+                                 resolve_partition_spec)
+from nbodykit_tpu.pmesh import ParticleMesh
+
+BOX = 100.0
+
+
+@pytest.fixture(autouse=True)
+def eight_device_mesh():
+    """Every test here runs with the full 8-device mesh ambient — the
+    regime the ingestion plane exists for."""
+    from nbodykit_tpu.parallel.runtime import tpu_mesh, use_mesh
+    with use_mesh(tpu_mesh()):
+        yield
+
+
+def _catalog(n, seed=0, box=BOX):
+    rng = np.random.RandomState(seed)
+    return (rng.uniform(0, box, size=(n, 3))).astype('f4')
+
+
+def _write_binary(tmp_path, pos, name='cat.bin'):
+    path = str(tmp_path / name)
+    with open(path, 'wb') as ff:
+        pos.astype('f4').tofile(ff)
+    return DataRef(path, 'binary',
+                   columns={'Position': 'Position'},
+                   options={'dtype': [('Position', ('f4', 3))]})
+
+
+def _pm(nmesh=32):
+    return ParticleMesh(Nmesh=nmesh, BoxSize=BOX, dtype='f4')
+
+
+# ---------------------------------------------------------------------------
+# partition rules
+
+def test_partition_rules_first_match_and_no_match():
+    from nbodykit_tpu.ingest import DEFAULT_RULES, ROWS
+    t = match_partition_rules(DEFAULT_RULES,
+                              {'Position': 2, 'Weight': 1,
+                               'Velocity': 2, 'Selection': 1})
+    assert t['Position'] == (ROWS, None)
+    assert t['Velocity'] == (ROWS, None)
+    assert t['Weight'] == (ROWS,)
+    assert t['Selection'] == (ROWS,)
+    # the catch-all soaks up anything (Ellipsis widened to the rank)
+    t2 = match_partition_rules(DEFAULT_RULES, {'Phi': 3})
+    assert t2['Phi'][0] == ROWS
+    with pytest.raises(ValueError):
+        match_partition_rules(((r'^Position$', (ROWS, None)),),
+                              {'Mass': 1})
+
+
+def test_resolve_partition_spec_on_live_mesh():
+    import jax
+    from jax.sharding import NamedSharding
+
+    from nbodykit_tpu.ingest import make_shard_and_gather_fns
+    from nbodykit_tpu.parallel.runtime import CurrentMesh
+    mesh = CurrentMesh.resolve(None)
+    from nbodykit_tpu.ingest import DEFAULT_RULES
+    templates = match_partition_rules(DEFAULT_RULES,
+                                      {'Position': 2, 'Weight': 1})
+    specs = {k: resolve_partition_spec(t, mesh)
+             for k, t in templates.items()}
+    shard_fns, gather_fns = make_shard_and_gather_fns(specs, mesh)
+    pos = _catalog(64)
+    dev = shard_fns['Position'](pos)
+    assert isinstance(dev.sharding, NamedSharding)
+    # leading axis sharded across the full device mesh
+    ndev = len(jax.devices())
+    assert dev.sharding.shard_shape(dev.shape)[0] == 64 // ndev
+    np.testing.assert_array_equal(gather_fns['Position'](dev), pos)
+
+
+# ---------------------------------------------------------------------------
+# reader partition: every row exactly once, uneven tails included
+
+@pytest.mark.parametrize('size,nranks', [
+    (0, 1), (1, 8), (7, 8), (8, 8), (10007, 8), (128, 3), (13, 5)])
+def test_row_range_exact_partition(size, nranks):
+    from nbodykit_tpu.io.base import FileType
+    f = FileType.__new__(FileType)
+    f.size = size
+    edges = [f.row_range(r, nranks) for r in range(nranks)]
+    # contiguous, ordered, exactly covering [0, size)
+    assert edges[0][0] == 0 and edges[-1][1] == size
+    for (a, b), (c, d) in zip(edges, edges[1:]):
+        assert b == c and a <= b and c <= d
+    # balanced to within one row
+    lens = [b - a for a, b in edges]
+    assert max(lens) - min(lens) <= 1
+    with pytest.raises(ValueError):
+        f.row_range(nranks, nranks)
+
+
+def _readers_with_uneven_rows(tmp_path):
+    """(reader, position-column) pairs over the same 617-row catalog
+    (617 is prime: every chunk_rows/nranks split has an uneven tail)."""
+    n = 617
+    pos = _catalog(n, seed=3)
+    out = []
+
+    path = str(tmp_path / 'u.bin')
+    with open(path, 'wb') as ff:
+        pos.tofile(ff)
+    out.append((nio.BinaryFile(
+        path, dtype=[('Position', ('f4', 3))]), 'Position'))
+
+    csv = str(tmp_path / 'u.csv')
+    np.savetxt(csv, pos)
+    out.append((nio.CSVFile(csv, names=['x', 'y', 'z']), 'x'))
+
+    try:
+        import h5py
+    except ImportError:
+        h5py = None
+    if h5py is not None:
+        h5 = str(tmp_path / 'u.h5')
+        with h5py.File(h5, 'w') as ff:
+            ff.create_dataset('Position', data=pos)
+        out.append((nio.HDFFile(h5, dataset='/'), 'Position'))
+
+    bf = str(tmp_path / 'u.bf')
+    with nio.BigFileWriter(bf) as ff:
+        ff.write('Position', pos, nfile=3)
+    out.append((nio.BigFile(bf), 'Position'))
+
+    out.append((ArraySource({'Position': pos}), 'Position'))
+    return out
+
+
+def test_read_chunks_exact_partition_all_readers(tmp_path):
+    """Concatenating read_chunks over all ranks reproduces the full
+    column for EVERY reader, at chunk sizes that leave uneven tails
+    both per-chunk and per-rank."""
+    for f, col in _readers_with_uneven_rows(tmp_path):
+        whole = f.read([col], 0, f.size)[col]
+        for nranks in (1, 8):
+            for chunk_rows in (100, 617, 1000):
+                got, sizes = [], []
+                for rank in range(nranks):
+                    for chunk in f.read_chunks([col], chunk_rows,
+                                               rank=rank,
+                                               nranks=nranks):
+                        got.append(chunk[col])
+                        sizes.append(len(chunk))
+                assert max(sizes) <= chunk_rows
+                np.testing.assert_array_equal(
+                    np.concatenate(got), whole,
+                    err_msg='%s nranks=%d chunk_rows=%d'
+                            % (type(f).__name__, nranks, chunk_rows))
+
+
+# ---------------------------------------------------------------------------
+# streaming: bit-identity + bounded host
+
+# NOTE on shapes: every painting test below uses chunk_rows=512 with
+# catalog sizes ≡ 8 (mod 512), so the whole file compiles exactly TWO
+# chunk-paint programs per device mesh — (512, 3) and the (8, 3) tail.
+# A novel chunk shape is a fresh XLA compile (~minutes on this 1-core
+# box); keep new tests on these shapes.
+CHUNK = 512
+
+
+def test_streaming_contract_single_device(tmp_path):
+    """The full contract — streamed == whole-load bits, cache hit ==
+    cold bits, zero warm reads, bounded host — on a 1-device sub-mesh
+    (a serve worker's regime).  This is the fast-tier guard; the
+    8-device variants below are the slow tier."""
+    from nbodykit_tpu.parallel.runtime import tpu_mesh, use_mesh
+    n = 2 * CHUNK + 8
+    pos = _catalog(n, seed=16)
+    ref = _write_binary(tmp_path, pos)
+    with use_mesh(tpu_mesh(1)):
+        pm = _pm()
+        cache = CatalogCache()
+        cold_f, _, cold = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                         cache=cache)
+        warm_f, _, warm = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                         cache=cache)
+        chunks = [(pos[s:s + CHUNK],
+                   np.ones(len(pos[s:s + CHUNK]), 'f4'),
+                   min(CHUNK, n - s)) for s in range(0, n, CHUNK)]
+        whole = paint_chunks(pm, chunks)
+    assert cold['chunks'] == 3 and not cold['cache_hit']
+    assert warm['cache_hit'] and warm['bytes'] == 0
+    np.testing.assert_array_equal(np.asarray(cold_f),
+                                  np.asarray(warm_f))
+    np.testing.assert_array_equal(np.asarray(cold_f),
+                                  np.asarray(whole))
+    assert cold['host_peak_bytes'] <= 2 * CHUNK * 3 * 4
+    assert abs(float(np.asarray(cold_f).sum()) - n) < 1e-3 * n
+
+
+def test_streamed_bit_identical_to_whole_load(tmp_path):
+    n = 8 * CHUNK + 8              # 8 full chunks + an uneven tail
+    pos = _catalog(n, seed=1)
+    ref = _write_binary(tmp_path, pos)
+    pm = _pm()
+    field, entry, stats = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                         overlap=True)
+    assert stats['rows'] == n and stats['chunks'] == 9
+    # whole catalog resident, pushed through the SAME canonical
+    # chunked deposit -> bit-identical
+    chunks = []
+    for s in range(0, n, CHUNK):
+        c = pos[s:s + CHUNK]
+        chunks.append((c, np.ones(len(c), 'f4'), len(c)))
+    whole = paint_chunks(pm, chunks)
+    np.testing.assert_array_equal(np.asarray(field), np.asarray(whole))
+    # total deposited mass is the particle count
+    assert abs(float(np.asarray(field).sum()) - n) < 1e-3 * n
+
+
+def test_overlap_and_serial_paths_bit_identical(tmp_path):
+    pos = _catalog(4 * CHUNK + 8, seed=2)
+    ref = _write_binary(tmp_path, pos)
+    pm = _pm()
+    f_on, _, s_on = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                   overlap=True)
+    f_off, _, s_off = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                     overlap=False)
+    assert s_on['overlap'] and not s_off['overlap']
+    np.testing.assert_array_equal(np.asarray(f_on), np.asarray(f_off))
+
+
+def test_host_never_holds_the_catalog(tmp_path):
+    """The streaming bound: peak host-resident chunk bytes is the
+    double buffer (<= 2 chunks), nowhere near the catalog."""
+    n = 16 * CHUNK + 8
+    ref = _write_binary(tmp_path, _catalog(n, seed=4))
+    _, _, stats = ingest_catalog(ref, _pm(), chunk_rows=CHUNK,
+                                 overlap=True)
+    catalog_bytes = n * 3 * 4
+    assert stats['bytes'] == catalog_bytes
+    assert stats['host_peak_bytes'] <= 2 * CHUNK * 3 * 4
+    assert stats['host_peak_bytes'] < catalog_bytes / 4
+
+
+def test_empty_catalog_structured_error(tmp_path):
+    path = str(tmp_path / 'empty.bin')
+    open(path, 'wb').close()
+    ref = DataRef(path, 'binary',
+                  options={'dtype': [('Position', ('f4', 3))]})
+    with pytest.raises(IngestError) as ei:
+        ingest_catalog(ref, _pm())
+    assert ei.value.code == 'empty_catalog'
+
+
+# ---------------------------------------------------------------------------
+# content-addressed cache
+
+def test_cache_hit_bit_identical_and_zero_reads(tmp_path):
+    ref = _write_binary(tmp_path, _catalog(4 * CHUNK + 8, seed=5))
+    pm = _pm()
+    cache = CatalogCache()
+    cold_f, cold_e, cold = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                          cache=cache)
+    warm_f, warm_e, warm = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                          cache=cache)
+    assert not cold['cache_hit'] and warm['cache_hit']
+    assert warm['bytes'] == 0          # no file, no wire
+    assert warm_e is cold_e
+    np.testing.assert_array_equal(np.asarray(cold_f),
+                                  np.asarray(warm_f))
+    st = cache.stats()
+    assert st == {'entries': 1, 'resident_bytes': st['resident_bytes'],
+                  'hits': 1, 'misses': 1, 'evictions': 0}
+    # paint_cached replays the same bits once more
+    np.testing.assert_array_equal(np.asarray(paint_cached(pm, cold_e)),
+                                  np.asarray(cold_f))
+
+
+def test_cache_misses_when_bytes_change(tmp_path):
+    n = 2 * CHUNK + 8
+    pos = _catalog(n, seed=6)
+    ref = _write_binary(tmp_path, pos)
+    pm = _pm()
+    cache = CatalogCache()
+    _, _, first = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                 cache=cache)
+    # rewrite the file with different bytes (and bump mtime)
+    with open(ref.path, 'wb') as ff:
+        _catalog(n, seed=7).tofile(ff)
+    os.utime(ref.path, (1, 1))
+    _, _, second = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                  cache=cache)
+    assert not second['cache_hit']
+    assert second['digest'] != first['digest']
+
+
+def test_eviction_under_shrunken_budget_reingests(tmp_path):
+    """An entry evicted for room is gone — the next request for it
+    re-ingests cold and lands back in the cache, bit-identically."""
+    pm = _pm()
+    n = 2 * CHUNK + 8
+    ref_a = _write_binary(tmp_path, _catalog(n, seed=8), 'a.bin')
+    ref_b = _write_binary(tmp_path, _catalog(n, seed=9), 'b.bin')
+    one_entry = 16 * n             # pos (12 B/row) + mass (4 B/row)
+    cache = CatalogCache(budget_bytes=int(one_entry * 1.5))
+    f_a, _, _ = ingest_catalog(ref_a, pm, chunk_rows=CHUNK,
+                               cache=cache)
+    ingest_catalog(ref_b, pm, chunk_rows=CHUNK, cache=cache)
+    assert cache.stats()['evictions'] == 1
+    assert cache.stats()['entries'] == 1
+    # A was the LRU victim: asking again is a miss + cold re-ingest
+    f_a2, _, again = ingest_catalog(ref_a, pm, chunk_rows=CHUNK,
+                                    cache=cache)
+    assert not again['cache_hit'] and again['rows'] == n
+    np.testing.assert_array_equal(np.asarray(f_a), np.asarray(f_a2))
+
+
+def test_cache_fits_predicate_prices_eviction(tmp_path):
+    """The memory_plan closure (not just the byte cap) drives
+    eviction: a predicate that refuses any resident catalog evicts
+    everything before the insert."""
+    pm = _pm()
+    n = CHUNK + 8
+    ref = _write_binary(tmp_path, _catalog(n, seed=10))
+    cache = CatalogCache()
+    ingest_catalog(ref, pm, chunk_rows=CHUNK, cache=cache)
+    assert cache.stats()['entries'] == 1
+    ref2 = _write_binary(tmp_path, _catalog(n, seed=11), 'c2.bin')
+    ingest_catalog(ref2, pm, chunk_rows=CHUNK, cache=cache,
+                   fits=lambda resident: resident <= n * 16)
+    st = cache.stats()
+    assert st['evictions'] >= 1 and st['entries'] >= 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+
+def test_fault_mid_stream_resumes_without_repainting(tmp_path):
+    from nbodykit_tpu.resilience import CheckpointStore
+    from nbodykit_tpu.resilience.faults import reset_faults
+    n = 4 * CHUNK + 8
+    pos = _catalog(n, seed=12)
+    ref = _write_binary(tmp_path, pos)
+    pm = _pm()
+    clean, _, _ = ingest_catalog(ref, pm, chunk_rows=CHUNK)
+
+    store = CheckpointStore(str(tmp_path / 'ckpt'))
+    with nbodykit_tpu.set_options(faults='ingest.chunk@3:unavailable'):
+        reset_faults()
+        with pytest.raises(Exception) as ei:
+            ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                           checkpoint=store, ckpt_key='k',
+                           ckpt_every=1)
+        assert 'UNAVAILABLE' in str(ei.value)
+    reset_faults()
+    assert store.keys()            # partial progress on disk
+    field, _, stats = ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                                     checkpoint=store, ckpt_key='k',
+                                     ckpt_every=1)
+    assert stats['resumed_chunks'] >= 1
+    np.testing.assert_array_equal(np.asarray(field), np.asarray(clean))
+    assert not store.keys()        # consumed on success
+
+
+def test_resume_refuses_changed_catalog(tmp_path):
+    from nbodykit_tpu.resilience import CheckpointStore
+    from nbodykit_tpu.resilience.faults import reset_faults
+    n = 4 * CHUNK + 8
+    ref = _write_binary(tmp_path, _catalog(n, seed=13))
+    pm = _pm()
+    store = CheckpointStore(str(tmp_path / 'ckpt'))
+    with nbodykit_tpu.set_options(faults='ingest.chunk@3:unavailable'):
+        reset_faults()
+        with pytest.raises(Exception):
+            ingest_catalog(ref, pm, chunk_rows=CHUNK,
+                           checkpoint=store, ckpt_key='k',
+                           ckpt_every=1)
+    reset_faults()
+    # same shape, different bytes: the digests must catch it
+    with open(ref.path, 'wb') as ff:
+        _catalog(n, seed=14).tofile(ff)
+    with pytest.raises(IngestError) as ei:
+        ingest_catalog(ref, pm, chunk_rows=CHUNK, checkpoint=store,
+                       ckpt_key='k', ckpt_every=1)
+    assert ei.value.code == 'checkpoint_mismatch'
+
+
+# ---------------------------------------------------------------------------
+# memory_plan pricing
+
+def test_memory_plan_prices_ingest():
+    from nbodykit_tpu.pmesh import memory_plan
+    base = memory_plan(Nmesh=64, npart=100000, ndevices=8)
+    plan = memory_plan(Nmesh=64, npart=100000, ndevices=8,
+                       ingest_chunk_rows=4096)
+    assert 'catalog_bytes' in plan
+    assert plan['ingest_chunk_buffers'] == 2 * 4 * 4 * 4096 / 8
+    assert plan['peak_bytes'] > base['peak_bytes']
+    # an explicit resident-cache total outprices the single entry
+    big = memory_plan(Nmesh=64, npart=100000, ndevices=8,
+                      ingest_chunk_rows=4096,
+                      catalog_bytes=10 * 16 * 100000)
+    assert big['catalog_bytes'] > plan['catalog_bytes']
+
+
+# ---------------------------------------------------------------------------
+# serving: data_ref end-to-end
+
+def test_request_data_ref_validation():
+    from nbodykit_tpu.serve import AnalysisRequest
+    d = {'path': '/tmp/x.bin', 'format': 'binary'}
+    r = AnalysisRequest(nmesh=32, data_ref=d)
+    assert r.data_ref['format'] == 'binary'
+    assert r.program_key(1)[-1] == 'data'
+    plain = AnalysisRequest(nmesh=32)
+    assert plain.program_key(1)[-1] != 'data'
+    with pytest.raises(ValueError):
+        AnalysisRequest(nmesh=32, algorithm='FFTCorr', data_ref=d)
+    with pytest.raises(IngestError):
+        AnalysisRequest(nmesh=32, data_ref={'path': 'x',
+                                            'format': 'parquet'})
+
+
+def test_probe_and_admission_reject_unreadable():
+    from nbodykit_tpu.serve import REJECT, AnalysisRequest, admit
+    ref = {'path': '/nonexistent/cat.bin', 'format': 'binary',
+           'options': {'dtype': [('Position', ('f4', 3))]}}
+    with pytest.raises(IngestError) as ei:
+        probe_ref(ref)
+    assert ei.value.code == 'unreadable_data_ref'
+    dec = admit(AnalysisRequest(nmesh=32, data_ref=ref), ndevices=8,
+                hbm_bytes=16 << 30)
+    assert dec.status == REJECT
+    assert dec.reason['code'] == 'unreadable_data_ref'
+
+
+def test_serve_data_ref_end_to_end_and_cache_hit(tmp_path):
+    """Two identical data_ref requests: both complete, the second
+    rides the worker's CatalogCache, the spectra agree to the bit,
+    and an unreadable path is REJECTED with a structured reason."""
+    from nbodykit_tpu.serve import (COMPLETED, REJECTED,
+                                    AnalysisRequest, AnalysisServer)
+    n = 4 * CHUNK + 8
+    ref = _write_binary(tmp_path, _catalog(n, seed=15))
+    d = ref.to_dict()
+    with nbodykit_tpu.set_options(ingest_chunk_rows=CHUNK), \
+            AnalysisServer(per_task=1, max_queue=8) as srv:
+        r1 = srv.wait(srv.submit(AnalysisRequest(
+            nmesh=32, data_ref=d, deadline_s=600.0)))
+        r2 = srv.wait(srv.submit(AnalysisRequest(
+            nmesh=32, data_ref=d, deadline_s=600.0)))
+        bad = srv.wait(srv.submit(AnalysisRequest(
+            nmesh=32, deadline_s=600.0,
+            data_ref={'path': str(tmp_path / 'missing.bin'),
+                      'format': 'binary',
+                      'options': d['options']})))
+        summary = srv.summary()
+    assert r1.status == COMPLETED and r2.status == COMPLETED
+    np.testing.assert_array_equal(np.asarray(r1.y), np.asarray(r2.y))
+    assert bad.status == REJECTED
+    assert bad.reason['code'] == 'unreadable_data_ref'
+    assert summary['ingest_requests'] == 2
+    assert summary['ingest_cache_hits'] == 1
+    assert summary['lost'] == 0
+    # admission filled npart from the file
+    assert summary['ingest_gb'] == round(n * 12 / 1e9, 6)
+
+
+# ---------------------------------------------------------------------------
+# posture plumbing: regress + doctor read the committed record
+
+def test_ingest_summary_reads_committed_round(tmp_path):
+    from nbodykit_tpu.diagnostics.regress import (build_history,
+                                                  ingest_summary,
+                                                  render_regress)
+    rec = {'metric': 'ingest_n1000', 'unit': 'GB/s', 'value': 1.5,
+           'rows': 1000, 'bytes': 12000, 'chunk_rows': 128,
+           'cold_gbs': 1.5, 'warm_gbs': 3.0, 'serial_gbs': 1.2,
+           'overlap_speedup': 1.25, 'host_peak_bytes': 3072,
+           'cache_hits': 1, 'cache_evictions': 0,
+           'serve_completed': 2, 'serve_cache_hits': 1,
+           'serve_lost': 0,
+           'measured_at': '2026-08-05T00:00:00Z'}
+    (tmp_path / 'BENCH_r01.json').write_text(json.dumps(
+        {'cmd': 'bench --ingest', 'rc': 0, 'parsed': rec}))
+    ing = ingest_summary(str(tmp_path))
+    assert ing['round'] == 'BENCH_r01.json'
+    assert ing['cold_gbs'] == 1.5 and ing['overlap_speedup'] == 1.25
+    history = build_history(str(tmp_path), write=False)
+    assert history['ingest']['serve_cache_hits'] == 1
+    text = render_regress(history)
+    line = next(l for l in text.splitlines()
+                if l.strip().startswith('ingest:'))
+    assert '1.5' in line and 'cache-hit' in line
+    assert ingest_summary(str(tmp_path / 'nowhere')) is None
+
+
+def test_doctor_ingest_thrash_verdict(tmp_path):
+    import io as _io
+
+    from nbodykit_tpu.diagnostics.__main__ import run_doctor
+    rec = {'metric': 'ingest_n1000', 'unit': 'GB/s', 'value': 1.5,
+           'rows': 1000, 'cold_gbs': 1.5, 'warm_gbs': 3.0,
+           'overlap_speedup': 1.25, 'cache_hits': 1,
+           'cache_evictions': 5, 'serve_completed': 2,
+           'serve_cache_hits': 1, 'serve_lost': 0,
+           'measured_at': '2026-08-05T00:00:00Z'}
+    (tmp_path / 'BENCH_r01.json').write_text(json.dumps(
+        {'cmd': 'bench --ingest', 'rc': 0, 'parsed': rec}))
+    buf = _io.StringIO()
+    run_doctor(trace=None, root=str(tmp_path), out=buf)
+    text = buf.getvalue()
+    line = next(l for l in text.splitlines()
+                if l.startswith('ingest'))
+    assert 'WARN' in line and 'thrash' in line
