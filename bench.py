@@ -59,6 +59,11 @@ Subcommands (internal):
                                       streaming catalog ingestion GB/s
                                       (cold / cache-hit / serialized)
                                       + e2e data_ref serving
+    bench.py --integrity [NMESH [NPART [REPS [SEED]]]]
+                                      tier-0 guard overhead (off vs
+                                      cheap) + the detect/retry proof
+                                      under an NBKIT_FAULTS corrupt
+                                      rule (docs/INTEGRITY.md)
 
 Global flags (any subcommand): --fft-decomp {slab,pencil,auto} and
 --pencil PXxPY override the FFT decomposition for the run; the
@@ -1242,6 +1247,98 @@ def run_ingest(npart=400000, nmesh=64, chunk_rows=None, seed=0):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def run_integrity(nmesh=64, npart=200000, reps=3, seed=7):
+    """The data-integrity round (docs/INTEGRITY.md): price the tier-0
+    guards and prove the detect -> retry -> deliver loop end to end.
+
+    Two measurements on the process-visible device mesh:
+
+    - *overhead*: the eager paint + r2c pipeline (every guard lives on
+      the eager path) timed under ``integrity='off'`` vs ``'cheap'`` —
+      ``overhead`` is the relative cost of the mass / Parseval / a2a
+      fold checks;
+    - *detection*: the same pipeline once under a Supervisor with
+      ``integrity='cheap'``.  When ``NBKIT_FAULTS`` carries a
+      ``corrupt`` rule (the regress round injects
+      ``a2a.payload@1:corrupt``) the owning guard raises a classified
+      IntegrityError, the supervisor strikes the rank and retries
+      exactly once, and the retry runs clean because injected rules
+      fire once — so the record proves the corruption was caught AND
+      the result was still delivered.
+
+    The record stamps ``integrity: {violations, retried}`` — the
+    ledger regress.py's integrity posture and the doctor judge.
+    ``value`` is the guarded (cheap) wall seconds."""
+    jax = _setup_jax()
+    import nbodykit_tpu
+    from nbodykit_tpu.parallel.runtime import (cpu_mesh, mesh_size,
+                                               tpu_mesh, use_mesh)
+    from nbodykit_tpu.pmesh import ParticleMesh
+    from nbodykit_tpu.resilience import (Supervisor, reset_faults,
+                                         reset_integrity,
+                                         violation_counts)
+    from nbodykit_tpu.tune.resolve import tuned_snapshot
+    from nbodykit_tpu.utils import is_mxu_backend
+    import contextlib
+
+    mesh = tpu_mesh() if is_mxu_backend() else cpu_mesh()
+    nproc = mesh_size(mesh)
+    rec = {"metric": "integrity_nmesh%d" % nmesh, "unit": "s",
+           "platform": jax.devices()[0].platform, "nmesh": nmesh,
+           "npart": npart, "nproc": nproc, "seed": seed,
+           "faults_spec": os.environ.get('NBKIT_FAULTS', '')}
+    reset_faults()
+    reset_integrity()
+    ctx = use_mesh(mesh) if nproc >= 2 else contextlib.nullcontext()
+    with ctx:
+        pm = ParticleMesh(Nmesh=nmesh, BoxSize=1000.0, dtype='f4')
+        import jax.numpy as jnp
+        pos = _make_pos(jax, jnp, npart, 1000.0, seed=seed)
+        _sync(jax, pos)
+
+        def once():
+            # eager on purpose: the tier-0 guards live on the eager
+            # dispatch path (a data-dependent raise cannot live under
+            # trace), so this is the surface they price and defend
+            field = pm.paint(pos)
+            out = pm.r2c(field)
+            _sync(jax, out)
+            return out
+
+        # detection FIRST: any configured corrupt rule is consumed
+        # here (rules fire once per process), so the timed passes
+        # below measure clean guarded reps, not injected failures
+        v0 = violation_counts()['violations']
+        sup = Supervisor('bench.integrity')
+        with nbodykit_tpu.set_options(integrity='cheap'):
+            sup.run(once)
+        vc = violation_counts()
+        rec['integrity'] = {
+            'violations': vc['violations'] - v0,
+            'retried': sum(1 for e in sup.events
+                           if e.get('kind') == 'integrity_retries')}
+        rec['violation_sites'] = vc['by_site']
+
+        def timed():
+            once()                              # warm (compile) rep
+            t0 = time.time()
+            for _ in range(reps):
+                once()
+            return (time.time() - t0) / reps
+
+        with nbodykit_tpu.set_options(integrity='off'):
+            rec['off_s'] = round(timed(), 5)
+        with nbodykit_tpu.set_options(integrity='cheap'):
+            rec['cheap_s'] = round(timed(), 5)
+    rec['reps'] = reps
+    rec['overhead'] = round(rec['cheap_s'] / max(rec['off_s'], 1e-9)
+                            - 1.0, 4)
+    rec['tuned'] = tuned_snapshot(nmesh=nmesh, npart=npart, dtype='f4',
+                                  nproc=nproc)
+    rec['value'] = rec['cheap_s']
+    return _stamp(rec)
+
+
 def _paint_method_options(method, Nmesh, Npart):
     """``set_options`` kwargs selecting one paint configuration by
     name.
@@ -1890,6 +1987,13 @@ if __name__ == '__main__':
             per_task=int(argv[2]) if argv[2:] else 1,
             max_batch=int(argv[3]) if argv[3:] else 8,
             seed=int(argv[4]) if argv[4:] else 0)))
+        sys.exit(0)
+    if argv[0] == '--integrity':
+        print(json.dumps(run_integrity(
+            int(argv[1]) if argv[1:] else 64,
+            npart=int(argv[2]) if argv[2:] else 200000,
+            reps=int(argv[3]) if argv[3:] else 3,
+            seed=int(argv[4]) if argv[4:] else 7)))
         sys.exit(0)
     if argv[0] == '--ingest':
         print(json.dumps(run_ingest(

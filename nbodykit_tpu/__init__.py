@@ -124,6 +124,21 @@ _default_options = {
     # workers (bench, multi-host) can be fault-injected without code
     # changes.
     'faults': os.environ.get('NBKIT_FAULTS') or None,
+    # silent-data-corruption defense tier (nbodykit_tpu.resilience.
+    # integrity, docs/INTEGRITY.md): 'off' (default — bit-identical to
+    # a build without the integrity layer, zero added ops) or 'cheap'
+    # (on-device invariants priced as near-free reductions: paint mass
+    # conservation, Parseval brackets around the distributed FFTs,
+    # NaN/Inf tripwires, fold-reduction checksums across every
+    # all_to_all wire format). Seeded from $NBKIT_INTEGRITY so
+    # detached workers can be armed without code changes.
+    'integrity': os.environ.get('NBKIT_INTEGRITY') or 'off',
+    # verify the per-physical-file byte-sum checksums bigfile columns
+    # are written with on first read (io/bigfile.py); a mismatch
+    # raises a structured ChecksumMismatch instead of silently
+    # analyzing corrupt rows. False skips verification (bulk loads
+    # where the caller audits out of band).
+    'io_verify_checksums': True,
 }
 
 
@@ -258,7 +273,28 @@ class set_options(object):
         (``'point@N:action[,...]'``) for
         :mod:`nbodykit_tpu.resilience.faults`; actions are
         ``unavailable`` / ``resource_exhausted`` / ``deadline`` /
-        ``internal`` / ``kill``.  None (the default) disables.
+        ``internal`` / ``kill`` / ``corrupt[:bits]`` (flip payload
+        bits at a named data-injection point — the testable stand-in
+        for real silent data corruption).  None (the default)
+        disables.
+    integrity : str
+        silent-data-corruption defense
+        (:mod:`nbodykit_tpu.resilience.integrity`, docs/INTEGRITY.md):
+        'off' (the default — bit-identical results and zero added
+        ops) or 'cheap' (tier-0 on-device invariants: exact paint
+        mass conservation, Parseval checks bracketing the distributed
+        FFTs, NaN/Inf tripwires on mesh-sized intermediates, and
+        fold-reduction checksums across every ``all_to_all`` payload
+        including the bf16/int16 compressed wire formats).  A
+        violation raises a classified
+        :class:`~nbodykit_tpu.resilience.IntegrityError`; the
+        Supervisor retries it exactly once.
+    io_verify_checksums : bool
+        verify each bigfile physical file's stored 32-bit byte-sum
+        checksum the first time the file is read
+        (:mod:`nbodykit_tpu.io.bigfile`); a mismatch raises
+        :class:`~nbodykit_tpu.io.bigfile.ChecksumMismatch` with the
+        file, column and both sums.  True by default; False opts out.
     """
 
     def __init__(self, **kwargs):

@@ -683,6 +683,54 @@ def run_doctor(trace=None, root='.', self_check_only=False,
             else:
                 lines.append('ingest       OK: %s' % desc)
 
+    if root is not None:
+        # integrity posture: tripwire violations caught vs retried
+        # clean, the shadow-verification ledger, and quarantined
+        # ranks.  The ONE hard failure is an unacknowledged shadow
+        # mismatch — a re-execution disagreed with the primary and no
+        # integrity retry followed, so a silently-divergent result may
+        # have been delivered.  A quarantined rank is the system
+        # working, but the hardware needs a look: WARN.
+        from .regress import integrity_summary
+        integ = integrity_summary(root)
+        if integ is None:
+            lines.append('integrity    SKIP: no integrity-stamped '
+                         'record, shadow ledger, or quarantine '
+                         'evidence in any committed round')
+        elif 'error' in integ:
+            warn.append('integrity')
+            lines.append('integrity    WARN: integrity summary '
+                         'unavailable (%s)' % integ['error'])
+        else:
+            desc = ('%d stamped record(s): %d violation(s) caught, '
+                    '%d retried clean; shadow %d verified / %d '
+                    'mismatch'
+                    % (integ.get('stamped_records', 0),
+                       integ.get('violations', 0),
+                       integ.get('retried', 0),
+                       integ.get('shadow_verified', 0),
+                       integ.get('shadow_mismatch', 0)))
+            unack = integ.get('unacknowledged_mismatch', 0)
+            quarantined = integ.get('quarantined') or []
+            if unack:
+                fail.append('integrity')
+                lines.append('integrity    FAIL: %d shadow '
+                             'mismatch(es) with NO integrity retry '
+                             '(%s) — a divergent result may have been '
+                             'delivered; see docs/INTEGRITY.md'
+                             % (unack, desc))
+            elif quarantined:
+                warn.append('integrity')
+                lines.append('integrity    WARN: rank(s) %s '
+                             'QUARANTINED in the sealed fleet '
+                             'manifest (%s) — the fleet healed '
+                             'itself, but the hardware behind those '
+                             'ranks needs attention'
+                             % (', '.join(map(str, quarantined)),
+                                desc))
+            else:
+                lines.append('integrity    OK: %s' % desc)
+
     verdict = 'FAIL (%s)' % ', '.join(fail) if fail else \
         ('WARN (%s)' % ', '.join(warn) if warn else 'OK')
     out.write('== nbodykit-tpu doctor ==\n')

@@ -447,6 +447,88 @@ def ingest_summary(root):
     return latest
 
 
+def integrity_summary(root):
+    """Data-integrity posture for the round record
+    (docs/INTEGRITY.md): every committed record carrying an
+    ``integrity`` stamp (tripwire violations caught / supervisor
+    retries that recovered them), the latest servetrace round's
+    shadow-verification ledger, and the quarantine lists riding the
+    sealed fleet manifests under ``root``/BENCH_CKPT.  The one number
+    the doctor FAILs on is ``unacknowledged_mismatch`` — a shadow
+    re-execution that disagreed with the primary and was NOT followed
+    by an integrity retry means a silently-divergent result may have
+    been delivered.  ``None`` when no evidence exists; never raises.
+    """
+    out = {'stamped_records': 0, 'violations': 0, 'retried': 0,
+           'shadow_verified': 0, 'shadow_mismatch': 0,
+           'integrity_retried': 0, 'quarantined': [],
+           'unacknowledged_mismatch': 0}
+    found = False
+    try:
+        for pattern in ROUND_GLOBS:
+            for path in sorted(glob.glob(os.path.join(root, pattern)),
+                               key=_round_key):
+                try:
+                    with open(path) as f:
+                        rec = json.load(f).get('parsed') or {}
+                except (OSError, ValueError):
+                    continue
+                stamp = rec.get('integrity')
+                if isinstance(stamp, dict):
+                    found = True
+                    out['stamped_records'] += 1
+                    out['violations'] += int(stamp.get('violations',
+                                                       0) or 0)
+                    out['retried'] += int(stamp.get('retried', 0) or 0)
+                if rec.get('shadow_verified') is not None:
+                    # the servetrace ledger: keep the LATEST record's
+                    # numbers (rounds sort oldest-first)
+                    found = True
+                    out['shadow_verified'] = \
+                        int(rec.get('shadow_verified') or 0)
+                    out['shadow_mismatch'] = \
+                        int(rec.get('shadow_mismatch') or 0)
+                    out['integrity_retried'] = \
+                        int(rec.get('integrity_retried') or 0)
+        for fname in ('BENCH_STAGED.json',) + CACHE_FILES:
+            try:
+                with open(os.path.join(root, fname)) as f:
+                    recs = json.load(f).get('results', {})
+            except (OSError, ValueError):
+                continue
+            for rec in recs.values():
+                stamp = rec.get('integrity') \
+                    if isinstance(rec, dict) else None
+                if isinstance(stamp, dict):
+                    found = True
+                    out['stamped_records'] += 1
+                    out['violations'] += int(stamp.get('violations',
+                                                       0) or 0)
+                    out['retried'] += int(stamp.get('retried', 0) or 0)
+        ckpt_dir = os.path.join(root, 'BENCH_CKPT')
+        if os.path.isdir(ckpt_dir):
+            # quarantine evidence rides the sealed manifest body —
+            # read the files directly so a half-written store cannot
+            # make the posture raise
+            quarantined = set()
+            for path in glob.glob(os.path.join(ckpt_dir,
+                                               '*.manifest.json')):
+                try:
+                    with open(path) as f:
+                        man = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                for r in man.get('quarantined') or []:
+                    found = True
+                    quarantined.add(int(r))
+            out['quarantined'] = sorted(quarantined)
+        out['unacknowledged_mismatch'] = max(
+            0, out['shadow_mismatch'] - out['integrity_retried'])
+        return out if found else None
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+
+
 # winner-option posture -> the margin key the precision harness
 # records in PRECISION.json (tests/test_precision.py and the smoke
 # precision gate both write through write_precision_margins)
@@ -575,6 +657,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'fleet': fleet_summary(root, now=now),
         'serve': serve_summary(root),
         'ingest': ingest_summary(root),
+        'integrity': integrity_summary(root),
         'precision': precision_summary(root, now=now),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
@@ -698,6 +781,30 @@ def render_regress(history):
               '%s GB/s cache-hit%s'
               % (ing.get('rows', '?'), ing.get('cold_gbs', '?'),
                  ing.get('warm_gbs', '?'),
+                 ' — %s' % '; '.join(bits) if bits else ''))
+    integ = history.get('integrity')
+    if integ is not None:
+        if 'error' in integ:
+            w('  integrity: unavailable (%s)' % integ['error'])
+        else:
+            bits = []
+            if integ.get('quarantined'):
+                bits.append('rank(s) %s QUARANTINED in the sealed '
+                            'fleet manifest'
+                            % ', '.join(map(str,
+                                            integ['quarantined'])))
+            if integ.get('unacknowledged_mismatch'):
+                bits.append('FAIL — %d shadow mismatch(es) with NO '
+                            'integrity retry: a divergent result may '
+                            'have been delivered'
+                            % integ['unacknowledged_mismatch'])
+            w('  integrity: %d stamped record(s) — %d violation(s) '
+              'caught, %d retried clean; shadow %d verified / %d '
+              'mismatch%s'
+              % (integ.get('stamped_records', 0),
+                 integ.get('violations', 0), integ.get('retried', 0),
+                 integ.get('shadow_verified', 0),
+                 integ.get('shadow_mismatch', 0),
                  ' — %s' % '; '.join(bits) if bits else ''))
     prec = history.get('precision')
     if prec is not None:

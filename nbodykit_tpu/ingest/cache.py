@@ -71,12 +71,15 @@ class CatalogEntry(object):
     def __init__(self, digest, layout, chunks, nrows, chunk_rows):
         self.digest = digest
         self.layout = layout
-        self.chunks = list(chunks)   # [(pos_dev, mass_dev, nvalid)]
+        # [(pos_dev, mass_dev, nvalid)] or, with a mapped Velocity
+        # column, [(pos_dev, mass_dev, nvalid, vel_dev)] — resident
+        # bytes price every device array in the chunk either way
+        self.chunks = list(chunks)
         self.nrows = int(nrows)
         self.chunk_rows = int(chunk_rows)
         self.nbytes = int(sum(
-            int(getattr(a, 'nbytes', 0)) + int(getattr(m, 'nbytes', 0))
-            for a, m, _ in self.chunks))
+            sum(int(getattr(a, 'nbytes', 0)) for a in c)
+            for c in self.chunks))
 
 
 class CatalogCache(object):

@@ -187,8 +187,9 @@ def _open_source(ref):
     of one, or a bare FileType (in-memory trials)."""
     if isinstance(ref, FileType):
         cols = {'Position': 'Position'}
-        if 'Weight' in (ref.dtype.names or ()):
-            cols['Weight'] = 'Weight'
+        for c in ('Weight', 'Velocity', 'Selection'):
+            if c in (ref.dtype.names or ()):
+                cols[c] = c
         return ref, cols
     ref = DataRef.from_dict(ref)
     return ref.open(), dict(ref.columns)
@@ -231,6 +232,10 @@ def _catalog_layout(f, cols, chunk_rows, mesh, rules=DEFAULT_RULES):
     logical = {'Position': 2}
     if 'Weight' in cols:
         logical['Weight'] = 1
+    if 'Velocity' in cols:
+        logical['Velocity'] = 2
+    if 'Selection' in cols:
+        logical['Selection'] = 1
     templates = match_partition_rules(rules, logical)
     specs = {k: resolve_partition_spec(t, mesh)
              for k, t in templates.items()}
@@ -264,7 +269,15 @@ class _HostMeter(object):
 def _put_chunk(chunk, cols, shard_fns, ndev, pos_dtype):
     """Pad a host chunk to the device count and place it under the
     partition specs.  Padding slots carry mass 0 — inert in the
-    deposit (pmesh.paint's documented contract)."""
+    deposit (pmesh.paint's documented contract).
+
+    A mapped ``Selection`` column multiplies into the effective
+    deposit mass on the host (FKP-style: a 0/1 mask or a completeness
+    weight scales each particle's contribution before it ever reaches
+    the device), so selection never forces the whole-resident catalog
+    path.  A mapped ``Velocity`` column is sharded alongside Position
+    and rides the chunk as a 4th element — resident for RSD-style
+    consumers, invisible to :func:`paint_chunks`."""
     import jax.numpy as jnp
     n = len(chunk)
     pad = (-n) % max(ndev, 1)
@@ -274,15 +287,30 @@ def _put_chunk(chunk, cols, shard_fns, ndev, pos_dtype):
                                     dtype=pos_dtype)
     else:
         mass = np.ones(n, dtype=pos_dtype)
+    if 'Selection' in cols:
+        mass = mass * np.ascontiguousarray(
+            chunk[cols['Selection']]).astype(pos_dtype)
+    vel = None
+    if 'Velocity' in cols:
+        vel = np.ascontiguousarray(chunk[cols['Velocity']],
+                                   dtype=pos_dtype)
     if pad:
         pos = np.concatenate(
             [pos, np.zeros((pad, 3), dtype=pos_dtype)])
         mass = np.concatenate([mass, np.zeros(pad, dtype=pos_dtype)])
-    nbytes = pos.nbytes + mass.nbytes
+        if vel is not None:
+            vel = np.concatenate(
+                [vel, np.zeros((pad, 3), dtype=pos_dtype)])
+    nbytes = pos.nbytes + mass.nbytes \
+        + (vel.nbytes if vel is not None else 0)
     with span('ingest.h2d', rows=n, bytes=nbytes):
         pos_dev = shard_fns['Position'](pos)
         mass_dev = shard_fns.get('Weight', jnp.asarray)(mass)
-    return pos_dev, mass_dev, n
+        if vel is None:
+            return pos_dev, mass_dev, n
+        vel_dev = shard_fns.get('Velocity',
+                                shard_fns['Position'])(vel)
+    return pos_dev, mass_dev, n, vel_dev
 
 
 def _chunk_digest(chunk, cols):
@@ -296,9 +324,11 @@ def paint_chunks(pm, chunks, resampler=None, out=None):
     """The canonical chunked deposit: paint each (pos, mass) chunk
     into the accumulator in order.  EVERY path to a painted ingest
     mesh goes through this op sequence — that is the bit-identity
-    contract."""
-    for pos, mass, _ in chunks:
-        out = pm.paint(pos, mass, resampler=resampler, out=out)
+    contract.  Chunks are ``(pos, mass, n)`` or ``(pos, mass, n,
+    vel)`` — a resident Velocity column rides along untouched."""
+    for chunk in chunks:
+        out = pm.paint(chunk[0], chunk[1], resampler=resampler,
+                       out=out)
     return out
 
 
@@ -447,7 +477,7 @@ def ingest_catalog(ref, pm, resampler=None, chunk_rows=None,
             if pending is not None:
                 pi = i - 1
                 if pi >= painted:
-                    acc = paint_chunks(pm, [pending[:3]],
+                    acc = paint_chunks(pm, [pending[:-1]],
                                        resampler=resampler, out=acc)
                     if not overlap:
                         jax.block_until_ready(acc)
@@ -455,15 +485,15 @@ def ingest_catalog(ref, pm, resampler=None, chunk_rows=None,
                         checkpoint, key, layout_id, chunk_rows,
                         pi + 1, digests, acc, ckpt_every, pm, mesh,
                         painted)
-                stored.append(pending[:3])
+                stored.append(pending[:-1])
                 fault_point('ingest.chunk')
             pending = dev + (hb,)
             i += 1
         if pending is not None:
             if i - 1 >= painted:
-                acc = paint_chunks(pm, [pending[:3]],
+                acc = paint_chunks(pm, [pending[:-1]],
                                    resampler=resampler, out=acc)
-            stored.append(pending[:3])
+            stored.append(pending[:-1])
             fault_point('ingest.chunk')
         jax.block_until_ready(acc)
     if acc is None:

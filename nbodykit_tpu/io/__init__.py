@@ -11,12 +11,12 @@ from .base import FileType
 from .stack import FileStack
 from .binary import BinaryFile
 from .csv import CSVFile
-from .bigfile import BigFile, BigFileWriter
+from .bigfile import BigFile, BigFileWriter, ChecksumMismatch
 from .hdf import HDFFile
 from .fits import FITSFile
 from .tpm import TPMBinaryFile
 from .gadget import Gadget1File
 
 __all__ = ['FileType', 'FileStack', 'BinaryFile', 'CSVFile', 'BigFile',
-           'BigFileWriter', 'HDFFile', 'FITSFile', 'TPMBinaryFile',
-           'Gadget1File']
+           'BigFileWriter', 'ChecksumMismatch', 'HDFFile', 'FITSFile',
+           'TPMBinaryFile', 'Gadget1File']

@@ -26,9 +26,15 @@ pure numpy with no C dependency):
 Compatibility notes:
 
 - per-file checksums are written as the 32-bit byte sum (the C
-  library's sysv-style accumulator); readers (including the C library)
-  do not verify them on load, so a checksum-convention mismatch cannot
-  break interchange;
+  library's sysv-style accumulator).  Unlike the C library (which
+  never re-checks them), this reader VERIFIES each physical file's
+  checksum the first time any of its rows are read, raising
+  :class:`ChecksumMismatch` on divergence — the on-disk leg of the
+  end-to-end integrity story (docs/INTEGRITY.md).  Opt out with
+  ``set_options(io_verify_checksums=False)``; headers whose entries
+  carry no checksum field — or a literal ``0`` placeholder, as some
+  foreign writers emit — skip verification
+  for those files rather than reject the whole block;
 - attributes are parsed from the first four whitespace-separated
   fields; everything after the hex payload (the ``#HUMANE [...]``
   comment the C library appends) is ignored, and string values stored
@@ -47,6 +53,33 @@ from ..utils import JSONEncoder, JSONDecoder
 
 _HEADER = 'header'
 _ATTRS = 'attr-v2'
+
+
+class ChecksumMismatch(IOError):
+    """A physical bigfile data file whose byte sum no longer matches
+    the checksum its header recorded at write time — disk rot, a torn
+    copy, or corruption in transfer.  Carries the exact provenance
+    (file, column, expected, got) so the operator knows WHICH file to
+    restore, not just that something is wrong."""
+
+    def __init__(self, file, column, expected, got):
+        self.file = str(file)
+        self.column = str(column)
+        self.expected = int(expected)
+        self.got = int(got)
+        super(ChecksumMismatch, self).__init__(
+            'bigfile checksum mismatch in %s (column %s): header '
+            'records %d, data sums to %d — restore the file or load '
+            'with set_options(io_verify_checksums=False)'
+            % (self.file, self.column, self.expected, self.got))
+
+
+def _verify_enabled():
+    try:
+        from .. import _global_options
+        return bool(_global_options['io_verify_checksums'])
+    except Exception:        # pragma: no cover - interpreter teardown
+        return True
 
 
 def _checksum(data):
@@ -223,6 +256,7 @@ class BigFileDataset(object):
 
     def __init__(self, root, name):
         self.dir = os.path.join(root, name)
+        self.name = name
         fn = os.path.join(self.dir, _HEADER)
         fields = {}
         entries = []
@@ -235,14 +269,21 @@ class BigFileDataset(object):
                 if key in ('DTYPE', 'NMEMB', 'NFILE'):
                     fields[key] = rest.strip()
                 else:
-                    entries.append((int(key, 16),
-                                    int(rest.split(':')[0])))
+                    parts = rest.split(':')
+                    cks = int(parts[1]) if len(parts) > 1 \
+                        and parts[1].strip() else None
+                    entries.append((int(key, 16), int(parts[0]), cks))
         self.dtype = np.dtype(fields['DTYPE'])
         self.nmemb = int(fields.get('NMEMB', 1))
         self.nfile = int(fields.get('NFILE', 0))
         sizes = np.zeros(self.nfile, dtype='i8')
-        for i, n in entries:
+        # header checksums, verified lazily per physical file on the
+        # first read that touches it (None = writer recorded none)
+        self.checksums = {}
+        self._verified = set()
+        for i, n, cks in entries:
             sizes[i] = n
+            self.checksums[i] = cks
         self.bounds = np.concatenate([[0], np.cumsum(sizes)])
         n = int(self.bounds[-1])
         self.shape = (n,) if self.nmemb == 1 else (n, self.nmemb)
@@ -252,11 +293,41 @@ class BigFileDataset(object):
     def size(self):
         return self.shape[0]
 
+    def _verify_files(self, start, stop):
+        """Checksum every not-yet-verified physical file overlapping
+        the record range [start, stop) against its header entry.  One
+        full-file read per file per process lifetime — the price of
+        knowing the bytes about to flow into a paint are the bytes the
+        writer committed."""
+        if not _verify_enabled():
+            return
+        for i in range(self.nfile):
+            lo, hi = self.bounds[i], self.bounds[i + 1]
+            if i in self._verified or hi <= start or lo >= stop:
+                continue
+            cks = self.checksums.get(i)
+            if not cks:
+                # None: writer recorded no checksum field.  0: several
+                # foreign writers emit a literal ': 0' placeholder
+                # without summing; a genuinely all-zero file passes a
+                # 0 check trivially, so skipping loses no coverage.
+                self._verified.add(i)
+                continue
+            fn = os.path.join(self.dir, '%06X' % i)
+            with open(fn, 'rb') as ff:
+                got = _checksum(ff.read())
+            if got != cks:
+                from ..diagnostics import counter
+                counter('io.checksum.mismatch').add(1)
+                raise ChecksumMismatch(fn, self.name, cks, got)
+            self._verified.add(i)
+
     def read(self, start, stop):
         if not (0 <= start <= stop <= self.size):
             raise IndexError(
                 "record range [%d, %d) outside block of size %d"
                 % (start, stop, self.size))
+        self._verify_files(start, stop)
         itemshape = self.shape[1:]
         nper = self.nmemb
         from . import _native

@@ -211,6 +211,126 @@ def _a2a_int16(y, axis_name, split_axis, concat_axis, nsplit):
     return jax.lax.complex(wide[0], wide[1]).astype(y.dtype)
 
 
+# --------------------------------------------------------------------
+# tier-0 integrity guards on the a2a wire (resilience/integrity.py;
+# docs/INTEGRITY.md).  An all_to_all permutes a global payload without
+# changing its elements, so the globally-psummed fold sum(|Re|+|Im|)
+# is wire-invariant; the compressed formats are checked
+# pre-quantization vs dequantized against the budget the format
+# itself implies.  All of this is OFF by default: the guard branch is
+# resolved at closure-build time (integrity='off' compiles the
+# identical program as before — zero added ops, bit-identical
+# results) and only eager drivers compare, since a data-dependent
+# raise cannot live under trace.
+# --------------------------------------------------------------------
+
+def _integrity_on():
+    from ..resilience.integrity import checks_enabled
+    return checks_enabled()
+
+
+def _corrupt_bits():
+    """Consult the ``a2a.payload`` corrupt injection point (fault
+    grammar ``corrupt[:bits]``) — 0 almost always."""
+    from ..resilience.faults import corrupt_spec
+    return corrupt_spec('a2a.payload')
+
+
+def _wire_fold(v):
+    """The wire-invariant fold: sum(|Re| + |Im|) in f32 (local)."""
+    return (jnp.sum(jnp.abs(jnp.real(v)).astype(jnp.float32)) +
+            jnp.sum(jnp.abs(jnp.imag(v)).astype(jnp.float32)))
+
+
+def _corrupt_wire(y, bits, axes):
+    """Deterministically flip ``bits`` top bits of ONE global payload
+    word (element [0,...] on the zero-coordinate rank).  The select is
+    rank-uniform — every rank runs the same program (NBK103) and the
+    where() picks the corrupted value only where every axis index is
+    zero."""
+    from ..resilience.integrity import corrupt_complex
+    idx = sum(jax.lax.axis_index(a) for a in axes)
+    return jnp.where(idx == 0, corrupt_complex(y, bits), y)
+
+
+def _a2a_site(y, axis_name, split_axis, concat_axis, nsplit, mode,
+              axes, check, bits):
+    """One a2a with optional corruption injection and optional guard
+    folds.  Returns ``(out, stats)`` where ``stats`` is None when
+    unchecked, else a psummed f32 triple [pre, post, qerr]: the fold
+    before the wire, the fold after (dequantized for compressed
+    formats), and the summed quantization-error bound (int16's
+    data-dependent scale, priced in-graph so the budget is honest).
+    The guarded program emits the SAME single all_to_all plus two
+    psums, identically on every rank."""
+    # ``check``/``bits`` are host-static (checks_enabled() and the
+    # consumed fault rule, identical on every rank), so the arms pick
+    # ONE program uniformly  # nbkl: disable=NBK103
+    if not check:
+        if bits:
+            y = _corrupt_wire(y, bits, axes)
+        return _a2a(y, axis_name, split_axis, concat_axis, nsplit,
+                    mode), None
+    pre = _wire_fold(y)
+    if mode == 'int16':
+        # mirror _a2a_int16's per-shard scale: each dequantized plane
+        # element is within scale/2 of its original, so the local fold
+        # can move by at most (2 * y.size) * scale / 2
+        m = jnp.maximum(jnp.max(jnp.abs(jnp.real(y))),
+                        jnp.max(jnp.abs(jnp.imag(y))))
+        scale = jnp.maximum(m.astype(jnp.float32),
+                            jnp.float32(1e-30)) / jnp.float32(32767.0)
+        qerr = jnp.float32(y.size) * scale
+    else:
+        qerr = jnp.float32(0)
+    if bits:
+        y = _corrupt_wire(y, bits, axes)
+    out = _a2a(y, axis_name, split_axis, concat_axis, nsplit, mode)
+    post = _wire_fold(out)
+    stats = jax.lax.psum(jnp.stack([pre, post, qerr]), axes)
+    return out, stats
+
+
+def _a2a_verify(site, stats, mode, n):
+    """Host-side comparison of one guarded a2a's psummed folds (eager
+    drivers only).  bf16 widens the budget by its mantissa step; int16
+    by twice the in-graph quantization bound; non-finite folds trip
+    the NaN/Inf tripwire inside check_a2a."""
+    import numpy as np
+    from ..resilience import integrity
+    pre, post, qerr = [float(v) for v in
+                       np.asarray(jax.device_get(stats))]
+    rel = integrity.rel_budget('float32', n)
+    if mode == 'bf16':
+        rel += 2.0 ** -8
+    budget = (pre * rel + 2.0 * qerr) if pre == pre else float('nan')
+    integrity.check_a2a(site, pre, post, budget)
+
+
+def _parseval_verify(site, shape, sx, y, norm):
+    """Parseval bracket for a forward rFFT (eager): the Hermitian-
+    weighted power of the output must equal the input power times the
+    transform's scale.  Runs at the public dist_rfftn entry so slab,
+    pencil and single-device paths are all covered by one guard."""
+    if norm not in (None, 'ortho'):
+        return
+    from ..resilience import integrity
+    n2 = int(shape[2])
+    p = jnp.square(jnp.abs(y).astype(jnp.float32))
+    s_all = jnp.sum(p)
+    # Hermitian double-count weights on the compressed z axis: the
+    # iz=0 column (and iz=Nc-1 when N2 is even) appears once in the
+    # full spectrum, every other column twice
+    s_edge = jnp.sum(p[:, :, 0])
+    if n2 % 2 == 0 and int(y.shape[2]) > 1:
+        s_edge = s_edge + jnp.sum(p[:, :, -1])
+    sk = float(2.0 * s_all - s_edge)
+    ntot = float(shape[0]) * float(shape[1]) * float(shape[2])
+    want = float(sx) * (ntot if norm is None else 1.0)
+    integrity.check_close(site, sk, want,
+                          integrity.rel_budget('float32', int(ntot)))
+
+
 def _lowmem_step(emit, upd, slab, buf, arr, k, r, stage):
     """One eager chunk of a lowmem pass, optionally wrapped in an
     ``fft.chunk`` span + wall histogram.  The per-chunk wall is
@@ -661,9 +781,14 @@ def _fft_chunked(a, axis, norm, target, inverse=False):
 
 @_lru_cache(maxsize=32)
 def _pencil_programs(mesh, shape, dtype_str, norm, kind, target,
-                     n_out=None, a2a='none'):
+                     n_out=None, a2a='none', check=False, bits1=0,
+                     bits2=0):
     """The two stage programs of one pencil transform, cached per
-    (mesh, shape, dtype, norm, kind, a2a wire format).
+    (mesh, shape, dtype, norm, kind, a2a wire format, integrity
+    posture).  ``check`` threads the tier-0 a2a guard folds through
+    both stages (each then returns ``(out, stats)``); ``bits1``/
+    ``bits2`` are transient corruption injections for the chaos
+    matrix (cache-keyed, so the clean program is never perturbed).
 
     ``kind`` is 'r2c', 'c2r', 'c2c' or 'ic2c'. Returns
     (stage1, stage2, jit1, jit2, pad): ``stage1``/``stage2`` are the
@@ -697,6 +822,7 @@ def _pencil_programs(mesh, shape, dtype_str, norm, kind, target,
     else:
         cdt = jnp.result_type(jnp.dtype(dtype_str), jnp.complex64)
 
+    axes = (AXIS_X, AXIS_Y)
     if fwd:
         def stage1(xl):
             # z-pencils (N0/Px, N1/Py, N2|Nz): transform z while it is
@@ -708,16 +834,20 @@ def _pencil_programs(mesh, shape, dtype_str, norm, kind, target,
                 y = _fft_chunked(xl.astype(cdt), 2, norm, target)
             if pad:
                 y = jnp.pad(y, ((0, 0), (0, 0), (0, pad)))
-            y = _a2a(y, AXIS_Y, 2, 1, py, a2a)
-            return _fft_chunked(y, 1, norm, target)
+            y, st = _a2a_site(y, AXIS_Y, 2, 1, py, a2a, axes, check,
+                              bits1)
+            out = _fft_chunked(y, 1, norm, target)
+            return (out, st) if check else out
 
         def stage2(yl):
             # y-pencils (N0/Px, N1, Nzp/Py): the OUTER transpose
             # (y <-> x across 'x' groups), the x-axis transform, and
             # the transposed (ky-leading) output layout
-            y = _a2a(yl, AXIS_X, 1, 0, px, a2a)
+            y, st = _a2a_site(yl, AXIS_X, 1, 0, px, a2a, axes, check,
+                              bits2)
             y = _fft_chunked(y, 0, norm, target)
-            return jnp.transpose(y, (1, 0, 2))
+            out = jnp.transpose(y, (1, 0, 2))
+            return (out, st) if check else out
 
         in1, out1 = P(AXIS_X, AXIS_Y, None), P(AXIS_X, None, AXIS_Y)
         in2, out2 = out1, P(AXIS_X, None, AXIS_Y)
@@ -727,26 +857,33 @@ def _pencil_programs(mesh, shape, dtype_str, norm, kind, target,
             # transform, then the OUTER transpose back
             z = jnp.transpose(yl, (1, 0, 2))
             z = _fft_chunked(z, 0, norm, target, inverse=True)
-            z = _a2a(z, AXIS_X, 0, 1, px, a2a)
-            return _fft_chunked(z, 1, norm, target, inverse=True)
+            z, st = _a2a_site(z, AXIS_X, 0, 1, px, a2a, axes, check,
+                              bits1)
+            out = _fft_chunked(z, 1, norm, target, inverse=True)
+            return (out, st) if check else out
 
         def stage2(zl):
             # y-pencils (N0/Px, N1, Nzp/Py): the INNER transpose back
             # (z whole again), drop the pad locally, undo the z-axis
             # transform
-            z = _a2a(zl, AXIS_Y, 1, 2, py, a2a)
+            z, st = _a2a_site(zl, AXIS_Y, 1, 2, py, a2a, axes, check,
+                              bits2)
             if pad:
                 z = z[:, :, :Nz]
             if kind == 'c2r':
-                return jnp.fft.irfft(z, n=int(n_out), axis=2,
-                                     norm=norm)
-            return _fft_chunked(z, 2, norm, target, inverse=True)
+                out = jnp.fft.irfft(z, n=int(n_out), axis=2,
+                                    norm=norm)
+            else:
+                out = _fft_chunked(z, 2, norm, target, inverse=True)
+            return (out, st) if check else out
 
         in1, out1 = P(AXIS_X, None, AXIS_Y), P(AXIS_X, None, AXIS_Y)
         in2, out2 = out1, P(AXIS_X, AXIS_Y, None)
 
-    s1 = jax.shard_map(stage1, mesh=mesh, in_specs=in1, out_specs=out1)
-    s2 = jax.shard_map(stage2, mesh=mesh, in_specs=in2, out_specs=out2)
+    o1 = (out1, P(None)) if check else out1
+    o2 = (out2, P(None)) if check else out2
+    s1 = jax.shard_map(stage1, mesh=mesh, in_specs=in1, out_specs=o1)
+    s2 = jax.shard_map(stage2, mesh=mesh, in_specs=in2, out_specs=o2)
     label = 'fft.pencil.%s' % kind
     j1 = instrumented_jit(s1, label=label + '.inner')
     j2 = instrumented_jit(s2, label=label + '.outer',
@@ -766,11 +903,20 @@ def _pencil_run(x, mesh, norm, kind, Nz_out=None):
     px, py = _pencil_shape(mesh)
     target = _fft_chunk_bytes(x.shape, x.dtype, mesh_shape=(px, py)) \
         or 2 ** 31
+    eager = not isinstance(x, jax.core.Tracer)
+    # integrity posture + chaos injection resolve at dispatch: each
+    # stage's a2a is one 'a2a.payload' injection consult, and guard
+    # comparison is eager-only (a data-dependent raise cannot live
+    # under trace — traced composition keeps the unchecked programs)
+    bits1 = _corrupt_bits() if eager else 0
+    bits2 = _corrupt_bits() if eager else 0
+    chk = eager and _integrity_on()
+    a2a = _a2a_mode(x.shape, x.dtype, mesh_shape=(px, py))
+    nglobal = int(x.size)
     s1, s2, j1, j2, pad = _pencil_programs(
         mesh, tuple(int(n) for n in x.shape), str(x.dtype), norm, kind,
         int(target), None if Nz_out is None else int(Nz_out),
-        _a2a_mode(x.shape, x.dtype, mesh_shape=(px, py)))
-    eager = not isinstance(x, jax.core.Tracer)
+        a2a, chk, bits1, bits2)
     if kind in ('c2r', 'ic2c') and pad:
         # the complex input's z axis is padded back to the transform's
         # internal %Py multiple; the pad columns are zeros and are
@@ -780,9 +926,15 @@ def _pencil_run(x, mesh, norm, kind, Nz_out=None):
                  pencil=[px, py]):
         mid = (j1 if eager else s1)(x)
     del x
+    if chk:
+        mid, st1 = mid
+        _a2a_verify('a2a.pencil.%s.stage1' % kind, st1, a2a, nglobal)
     with span_if(eager, 'fft.a2a.outer', kind=kind, group=px,
                  pencil=[px, py]):
         out = (j2 if eager else s2)(mid)
+    if chk:
+        out, st2 = out
+        _a2a_verify('a2a.pencil.%s.stage2' % kind, st2, a2a, nglobal)
     if kind in ('r2c', 'c2c') and pad:
         # the forward output carries zero pad columns on the z axis
         # (they lived on the last 'y' rank); slice back to the
@@ -841,10 +993,23 @@ def dist_rfftn(x, mesh=None, norm=None):
     through the transform).  For the driver's ~2-buffer ownership
     contract call :func:`rfftn_single_lowmem` directly.
     """
-    with span_if(not isinstance(x, jax.core.Tracer), 'fft.r2c',
-                 nproc=mesh_size(mesh),
-                 shape=[int(s) for s in x.shape]):
-        return _dist_rfftn_impl(x, mesh, norm)
+    eager = not isinstance(x, jax.core.Tracer)
+    chk = eager and _integrity_on()
+    shape = tuple(int(s) for s in x.shape)
+    if chk:
+        # the input power, folded BEFORE the transform consumes the
+        # field (the lowmem driver may free it); compared against the
+        # Hermitian-weighted output power after — the Parseval bracket
+        # (docs/INTEGRITY.md), which also trips on any NaN/Inf that
+        # poisons a mesh-sized intermediate
+        sx = float(jnp.sum(jnp.square(
+            jnp.real(jnp.asarray(x)).astype(jnp.float32))))
+    with span_if(eager, 'fft.r2c', nproc=mesh_size(mesh),
+                 shape=list(shape)):
+        out = _dist_rfftn_impl(x, mesh, norm)
+    if chk:
+        _parseval_verify('fft.parseval.r2c', shape, sx, out, norm)
+    return out
 
 
 def _dist_rfftn_impl(x, mesh, norm):
@@ -879,19 +1044,29 @@ def _dist_rfftn_impl(x, mesh, norm):
         raise ValueError("Nmesh[0] and Nmesh[1] must be divisible by the "
                          "device count %d, got %s" % (nproc, (N0, N1, N2)))
     a2a = _a2a_mode(x.shape, x.dtype)
+    eager = not isinstance(x, jax.core.Tracer)
+    bits = _corrupt_bits() if eager else 0
+    chk = eager and _integrity_on()
 
     def local(xl):
         y = jnp.fft.rfft(xl, axis=2, norm=norm)
         y = jnp.fft.fft(y, axis=1, norm=norm)
         # (N0/P, N1, Nc) -> (N0, N1/P, Nc)
-        y = _a2a(y, AXIS, 1, 0, nproc, a2a)
+        y, st = _a2a_site(y, AXIS, 1, 0, nproc, a2a, (AXIS,), chk,
+                          bits)
         y = jnp.fft.fft(y, axis=0, norm=norm)
-        return jnp.transpose(y, (1, 0, 2))
+        out = jnp.transpose(y, (1, 0, 2))
+        return (out, st) if chk else out
 
-    return jax.shard_map(
+    res = jax.shard_map(
         local, mesh=mesh,
         in_specs=P(AXIS, None, None),
-        out_specs=P(AXIS, None, None))(x)
+        out_specs=(P(AXIS, None, None), P(None)) if chk
+        else P(AXIS, None, None))(x)
+    if chk:
+        res, st = res
+        _a2a_verify('a2a.slab.r2c', st, a2a, int(N0 * N1 * N2))
+    return res
 
 
 def dist_irfftn(y, Nmesh2, mesh=None, norm=None):
@@ -933,20 +1108,30 @@ def _dist_irfftn_impl(y, Nmesh2, mesh, norm):
         return jnp.fft.irfftn(yt, s=(yt.shape[0], yt.shape[1], Nmesh2), norm=norm)
 
     a2a = _a2a_mode(y.shape, y.dtype)
+    eager = not isinstance(y, jax.core.Tracer)
+    bits = _corrupt_bits() if eager else 0
+    chk = eager and _integrity_on()
 
     def local(yl):
         # (N1/P, N0, Nc) -> (N0, N1/P, Nc)
         z = jnp.transpose(yl, (1, 0, 2))
         z = jnp.fft.ifft(z, axis=0, norm=norm)
         # (N0, N1/P, Nc) -> (N0/P, N1, Nc)
-        z = _a2a(z, AXIS, 0, 1, nproc, a2a)
+        z, st = _a2a_site(z, AXIS, 0, 1, nproc, a2a, (AXIS,), chk,
+                          bits)
         z = jnp.fft.ifft(z, axis=1, norm=norm)
-        return jnp.fft.irfft(z, n=Nmesh2, axis=2, norm=norm)
+        out = jnp.fft.irfft(z, n=Nmesh2, axis=2, norm=norm)
+        return (out, st) if chk else out
 
-    return jax.shard_map(
+    res = jax.shard_map(
         local, mesh=mesh,
         in_specs=P(AXIS, None, None),
-        out_specs=P(AXIS, None, None))(y)
+        out_specs=(P(AXIS, None, None), P(None)) if chk
+        else P(AXIS, None, None))(y)
+    if chk:
+        res, st = res
+        _a2a_verify('a2a.slab.c2r', st, a2a, int(y.size))
+    return res
 
 
 def _fftn_c2c_single_chunked(x, inverse, norm, target):
@@ -1047,25 +1232,38 @@ def _dist_fftn_c2c_impl(x, mesh, inverse, norm):
         return jnp.transpose(jnp.fft.fftn(x, norm=norm), (1, 0, 2))
 
     a2a = _a2a_mode(x.shape, x.dtype)
+    eager = not isinstance(x, jax.core.Tracer)
+    bits = _corrupt_bits() if eager else 0
+    chk = eager and _integrity_on()
     if not inverse:
         def local(xl):
             y = fft(xl, axis=2, norm=norm)
             y = fft(y, axis=1, norm=norm)
-            y = _a2a(y, AXIS, 1, 0, nproc, a2a)
+            y, st = _a2a_site(y, AXIS, 1, 0, nproc, a2a, (AXIS,),
+                              chk, bits)
             y = fft(y, axis=0, norm=norm)
-            return jnp.transpose(y, (1, 0, 2))
+            out = jnp.transpose(y, (1, 0, 2))
+            return (out, st) if chk else out
     else:
         def local(yl):
             z = jnp.transpose(yl, (1, 0, 2))
             z = fft(z, axis=0, norm=norm)
-            z = _a2a(z, AXIS, 0, 1, nproc, a2a)
+            z, st = _a2a_site(z, AXIS, 0, 1, nproc, a2a, (AXIS,),
+                              chk, bits)
             z = fft(z, axis=1, norm=norm)
-            return fft(z, axis=2, norm=norm)
+            out = fft(z, axis=2, norm=norm)
+            return (out, st) if chk else out
 
-    return jax.shard_map(
+    res = jax.shard_map(
         local, mesh=mesh,
         in_specs=P(AXIS, None, None),
-        out_specs=P(AXIS, None, None))(x)
+        out_specs=(P(AXIS, None, None), P(None)) if chk
+        else P(AXIS, None, None))(x)
+    if chk:
+        res, st = res
+        _a2a_verify('a2a.slab.%s' % ('ic2c' if inverse else 'c2c'),
+                    st, a2a, int(x.size))
+    return res
 
 
 def _parse_pencil(v):

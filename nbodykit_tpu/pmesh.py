@@ -376,6 +376,17 @@ class ParticleMesh(object):
 
         pm_method = pcfg['paint_method']
         traced = isinstance(cpos, jax.core.Tracer)
+        # tier-0 integrity posture + chaos injection resolve here, at
+        # dispatch: both are eager-only (a data-dependent raise cannot
+        # live under trace) and integrity='off' takes the exact same
+        # code path as before — zero added ops, bit-identical fields
+        cbits = 0
+        chk = False
+        if not traced:
+            from .resilience.faults import corrupt_spec
+            from .resilience.integrity import checks_enabled
+            cbits = corrupt_spec('paint.accum')
+            chk = checks_enabled()
         if traced and pm_method == 'mxu' and not return_dropped \
                 and pcfg['source'] != 'explicit':
             # a tune-cache winner must not impose the traced-mxu
@@ -455,8 +466,12 @@ class ParticleMesh(object):
             # kernels return compute dtype; widen any caller-held
             # accumulator before adding (never mix widths on a
             # mesh-sized operand) and narrow once at the exit
+            if cbits:
+                block = self._corrupt_accum(block, cbits)
             if out is not None:
                 block = block + jnp.asarray(out).astype(block.dtype)
+            if chk:
+                self._verify_mass(block, massa, out, h, npart)
             out = block.astype(self.dtype)
             if return_dropped:
                 return out, over
@@ -524,12 +539,47 @@ class ParticleMesh(object):
         # same merge-then-narrow contract as the single-device exit:
         # the halo_add ran in compute dtype inside the shard_map, the
         # storage cast happens exactly once, here
+        if cbits:
+            block = self._corrupt_accum(block, cbits)
         if out is not None:
             block = block + jnp.asarray(out).astype(block.dtype)
+        if chk:
+            self._verify_mass(block, massa, out, h, npart)
         out = block.astype(self.dtype)
         if return_dropped:
             return out, dropped + over
         return out
+
+    def _corrupt_accum(self, block, bits):
+        """Chaos-matrix injection for the ``paint.accum`` point: flip
+        the top ``bits`` bits of one accumulated cell (before the
+        merge, so the mass guard — not the injector — must catch it).
+        Active regardless of the integrity mode: with checks off the
+        corruption flows through silently, which IS the documented
+        blind spot the tier exists to close."""
+        from .resilience.integrity import corrupt_real
+        return corrupt_real(block, bits)
+
+    def _verify_mass(self, block, massa, prior, h, npart):
+        """Tier-0 mass-conservation guard (resilience/integrity.py):
+        the deposit windows sum to one per particle, so the merged
+        field's global sum must equal the deposited mass plus any
+        caller-held accumulator, within a compute-dtype budget widened
+        for narrow (bf16) mesh storage.  The folds double as NaN/Inf
+        tripwires on the mesh-sized accumulator."""
+        from .resilience import integrity
+        f4 = jnp.float32
+        expected = jnp.sum(massa.astype(f4))
+        scale = jnp.sum(jnp.abs(massa).astype(f4))
+        if prior is not None:
+            pw = jnp.asarray(prior).astype(f4)
+            expected = expected + jnp.sum(pw)
+            scale = scale + jnp.sum(jnp.abs(pw))
+        total = float(jnp.sum(block.astype(f4)))
+        n = max(int(npart), 1) * int(h) ** 3
+        integrity.check_mass('paint.mass', total, float(expected),
+                             float(scale), n, self.compute_dtype,
+                             self.dtype)
 
     def _note_dropped(self, count, slack):
         """Observability of an eager mxu bucket overflow, BEFORE the

@@ -34,6 +34,14 @@ in.  Three pieces:
   :class:`Preempted`), a live heartbeat failure detector
   (:class:`FleetMonitor`), and shrink-to-survive shard repartitioning
   for relaunches with fewer processes.
+- :mod:`.integrity` — the silent-data-corruption defense the four
+  above cannot provide (they handle *loud* failures): tier-0
+  on-device invariants (``set_options(integrity='cheap')`` — paint
+  mass conservation, FFT Parseval, a2a fold checksums, NaN/Inf
+  tripwires), classified :class:`IntegrityError` attribution, and the
+  tier-2 :class:`SuspectTracker` quarantine in :mod:`.fleet`.  The
+  fault grammar's ``corrupt[:bits]`` action makes every detector
+  testable in CI.  Full guide: docs/INTEGRITY.md.
 
 Wired in: ``bench.py``'s measurement reps checkpoint after every rep
 and resume on relaunch (records carry ``resumed: true``); the
@@ -44,16 +52,22 @@ Full guide: docs/RESILIENCE.md.
 """
 
 from .checkpoint import CheckpointStore  # noqa: F401
-from .faults import (ACTIONS, InjectedFault, error_class,  # noqa: F401
-                     fault_counts, fault_point, parse_spec,
-                     reset_faults)
+from .faults import (ACTIONS, InjectedFault, corrupt_spec,  # noqa: F401
+                     error_class, fault_counts, fault_point,
+                     parse_spec, reset_faults)
 from .fleet import (DEAD_RANK_EXIT, PREEMPTED_EXIT,  # noqa: F401
                     FleetCheckpointStore, FleetMonitor, FleetSealError,
-                    Preempted, check_preemption,
+                    Preempted, SuspectTracker, check_preemption,
                     clear_preemption, fleet_barrier, fleet_rank,
                     fleet_size, install_preemption_handler,
                     preemption_requested, reassemble, repartition,
-                    scan_liveness, uninstall_preemption_handler)
-from .supervise import (DEADLINE, FATAL, OOM, TRANSIENT,  # noqa: F401
-                        DegradationLadder, RetryPolicy, Supervisor,
-                        classify_error, default_ladder, scoped_ladder)
+                    reset_suspects, scan_liveness, suspect_tracker,
+                    uninstall_preemption_handler)
+from .integrity import (IntegrityError, checks_enabled,  # noqa: F401
+                        integrity_mode, precision_margins,
+                        reset_integrity, shadow_margin,
+                        violation_counts)
+from .supervise import (DEADLINE, FATAL, INTEGRITY, OOM,  # noqa: F401
+                        TRANSIENT, DegradationLadder, RetryPolicy,
+                        Supervisor, classify_error, default_ladder,
+                        scoped_ladder)

@@ -29,6 +29,16 @@ of:
   with the preemption handler installed (:mod:`.fleet`) execution
   continues to the next safe point exactly as under a preemptible
   scheduler; without one the default disposition terminates.
+- ``corrupt[:bits]`` — a DATA action, not an error: at a named
+  data-injection point (``a2a.payload``, ``paint.accum``,
+  ``serve.result``) the site consults :func:`corrupt_spec` and, when
+  the rule fires, applies a deterministic stuck-at-one fault to the
+  top ``bits`` (default 1) of one payload word's exponent
+  (:func:`integrity.flip_bits_value` — catastrophic by construction,
+  so detection never depends on the corrupted element's value).  This is how every silent-data-corruption
+  detector (:mod:`.integrity`, docs/INTEGRITY.md) is exercised in CI
+  without real hardware faults: the corruption flows through the
+  guarded surface and the guard — not the injector — must catch it.
 
 The optional ``rankR@`` prefix scopes a rule to one fleet rank
 (``rank1@bench.rep:sigkill`` kills only rank 1), which is how the
@@ -62,7 +72,8 @@ _STATUS_MESSAGES = {
     'deadline': 'DEADLINE_EXCEEDED: injected fault at %s (call %d)',
     'internal': 'INTERNAL: injected fault at %s (call %d)',
 }
-ACTIONS = tuple(_STATUS_MESSAGES) + ('kill', 'sigkill', 'sigterm')
+ACTIONS = tuple(_STATUS_MESSAGES) + ('kill', 'sigkill', 'sigterm',
+                                     'corrupt')
 
 _RANK_RE = re.compile(r'^rank(\d+)$')
 
@@ -109,10 +120,22 @@ def parse_spec(spec):
             raise ValueError('fault rule %r: expected point@N:action'
                              % part)
         action = action.strip().lower()
-        if action not in ACTIONS:
+        if name.lower().endswith(':corrupt') and action.isdigit():
+            # 'point:corrupt:3' — the bits suffix landed in rpartition's
+            # tail; fold it back into a single 'corrupt:N' action
+            name = name[:-len(':corrupt')]
+            action = 'corrupt:' + action
+        base = action.partition(':')[0]
+        if base not in ACTIONS or (base != 'corrupt' and base != action):
             raise ValueError('fault rule %r: unknown action %r '
                              '(choose %s)' % (part, action,
                                               '/'.join(ACTIONS)))
+        if base == 'corrupt':
+            bits = action.partition(':')[2]
+            if bits and (not bits.isdigit() or not 1 <= int(bits) <= 30):
+                raise ValueError('fault rule %r: corrupt bit count %r '
+                                 'must be an integer in [1, 30]'
+                                 % (part, bits))
         point, at, nth = name.partition('@')
         rank = None
         m = _RANK_RE.match(point.strip())
@@ -173,7 +196,9 @@ def fault_point(name):
         n = _counts[name] = _counts.get(name, 0) + 1
     for rule in mine:
         nth, action = rule[1], rule[2]
-        if nth != n:
+        if nth != n or action.startswith('corrupt'):
+            # corrupt rules are DATA actions consumed by corrupt_spec
+            # at the injection site, never raised from a fault point
             continue
         if len(rule) > 3:
             from .fleet import fleet_rank
@@ -190,3 +215,39 @@ def fault_point(name):
             os.kill(os.getpid(), signal.SIGTERM)
             continue
         raise error_class()(_STATUS_MESSAGES[action] % (name, n))
+
+
+def corrupt_spec(name):
+    """Declare a named DATA-injection point: the number of payload
+    bits to flip at this call (0 almost always).
+
+    The query form of :func:`fault_point` for ``corrupt`` rules: the
+    site calls this once per logical payload, and when a rule matches
+    (name, call count) it returns the rule's bit count — the site then
+    flips that many top bits of one payload word itself (the
+    corruption must flow through the guarded surface so the DETECTOR
+    is what gets tested, not the injector).  Counting shares
+    :func:`fault_point`'s per-process table and stays rank-uniform;
+    rank-scoped rules return 0 everywhere but their fleet rank (every
+    rank still counts the call, so all ranks agree on indices).  Each
+    rule fires once.  Free when no rule targets ``name``."""
+    rules = _rules()
+    if not rules:
+        return 0
+    mine = [r for r in rules if r[0] == name]
+    if not mine:
+        return 0
+    with _lock:
+        n = _counts[name] = _counts.get(name, 0) + 1
+    for rule in mine:
+        nth, action = rule[1], rule[2]
+        if nth != n or not action.startswith('corrupt'):
+            continue
+        if len(rule) > 3:
+            from .fleet import fleet_rank
+            if fleet_rank() != rule[3]:
+                continue
+        counter('resilience.faults.injected').add(1)
+        bits = action.partition(':')[2]
+        return int(bits) if bits else 1
+    return 0

@@ -121,6 +121,103 @@ def fleet_size():
 
 
 # ---------------------------------------------------------------------------
+# tier-2 integrity attribution: suspect ranks and quarantine
+# (docs/INTEGRITY.md).  The Supervisor records a strike here for every
+# classified IntegrityError; after K strikes the rank is quarantined —
+# the quarantine list rides the sealed fleet manifest (hash-covered),
+# is adopted by any process that loads the checkpoint, and a
+# quarantined rank refuses to rejoin at load time, so shrink-to-
+# survive re-formation proceeds without the suspect chip.
+
+class SuspectTracker(object):
+    """Count integrity strikes per fleet rank; quarantine after K.
+
+    ``strikes_to_quarantine`` defaults to 2 (one retry heals a
+    transient bit flip; a second violation on the same rank is a
+    pattern) and is overridable via ``$NBKIT_INTEGRITY_STRIKES``.
+    Thread-safe; process-local, with :meth:`adopt` merging the sealed
+    manifest's quarantine list on fleet re-formation."""
+
+    def __init__(self, strikes=None):
+        if strikes is None:
+            strikes = os.environ.get('NBKIT_INTEGRITY_STRIKES') or 2
+        self.strikes_to_quarantine = max(1, int(strikes))
+        self._lock = threading.Lock()
+        self._strikes = {}
+        self._quarantined = set()
+
+    def strike(self, rank=None, site=None, task=None):
+        """Record one integrity strike against ``rank`` (default: this
+        process's fleet rank).  Returns the rank's strike count; the
+        K-th strike quarantines the rank and emits the
+        ``resilience.fleet.quarantined`` counter + trace event."""
+        rank = fleet_rank() if rank is None else int(rank)
+        with self._lock:
+            recs = self._strikes.setdefault(rank, [])
+            recs.append({'site': site, 'task': task,
+                         'at': round(time.time(), 3)})
+            n = len(recs)
+            newly = (n >= self.strikes_to_quarantine
+                     and rank not in self._quarantined)
+            if newly:
+                self._quarantined.add(rank)
+        counter('resilience.fleet.strikes').add(1)
+        if newly:
+            counter('resilience.fleet.quarantined').add(1)
+            tr = current_tracer()
+            if tr is not None:
+                tr.event('resilience.fleet.quarantined',
+                         {'rank': rank, 'strikes': n,
+                          'site': site, 'task': task})
+        return n
+
+    def adopt(self, ranks):
+        """Merge an externally-recorded quarantine list (the sealed
+        manifest's) into this process's view."""
+        with self._lock:
+            self._quarantined.update(int(r) for r in (ranks or ()))
+
+    def quarantined(self):
+        with self._lock:
+            return sorted(self._quarantined)
+
+    def is_quarantined(self, rank):
+        with self._lock:
+            return int(rank) in self._quarantined
+
+    def strike_counts(self):
+        with self._lock:
+            return {r: len(v) for r, v in self._strikes.items()}
+
+    def summary(self):
+        """Posture dict for regress/doctor: strikes + quarantine."""
+        with self._lock:
+            return {'strikes': sum(len(v)
+                                   for v in self._strikes.values()),
+                    'by_rank': {str(r): len(v)
+                                for r, v in self._strikes.items()},
+                    'quarantined': sorted(self._quarantined)}
+
+    def reset(self):
+        with self._lock:
+            self._strikes.clear()
+            self._quarantined.clear()
+
+
+_suspects = SuspectTracker()
+
+
+def suspect_tracker():
+    """The process-wide :class:`SuspectTracker` singleton."""
+    return _suspects
+
+
+def reset_suspects():
+    """Clear strikes + quarantine (test isolation)."""
+    _suspects.reset()
+
+
+# ---------------------------------------------------------------------------
 # preemption: SIGTERM -> safe-point Preempted inside a grace budget
 
 _preempt_lock = threading.Lock()
@@ -450,13 +547,19 @@ class FleetCheckpointStore(object):
                 'file': os.path.basename(self.store._meta_path(skey)),
                 'sha256': self._shard_sha(key, seq, r),
             }
-        body = _canonical({'key': str(key), 'seq': int(seq),
-                           'nranks': int(nranks), 'decomp': decomp,
-                           'shards': shards})
-        man = {'v': 1, 'key': str(key), 'seq': int(seq),
-               'nranks': int(nranks), 'decomp': decomp,
-               'shards': shards, 'sealed_at': round(time.time(), 6),
-               'sha256': _sha(body)}
+        payload = {'key': str(key), 'seq': int(seq),
+                   'nranks': int(nranks), 'decomp': decomp,
+                   'shards': shards}
+        # the quarantine list rides the SEALED body (hash-covered):
+        # a re-formed fleet adopting this checkpoint inherits which
+        # ranks are suspect.  Only present when non-empty, so every
+        # previously-sealed manifest keeps verifying unchanged.
+        quarantined = suspect_tracker().quarantined()
+        if quarantined:
+            payload['quarantined'] = quarantined
+        body = _canonical(payload)
+        man = dict(payload, v=1, sealed_at=round(time.time(), 6),
+                   sha256=_sha(body))
         path = self._manifest_path(key, seq)
         from .faults import fault_point
         # pre-commit fault points: a kill here proves the previous
@@ -553,10 +656,13 @@ class FleetCheckpointStore(object):
                 man = json.load(f)
         except (OSError, ValueError):
             return None
-        body = _canonical({'key': man.get('key'), 'seq': man.get('seq'),
-                           'nranks': man.get('nranks'),
-                           'decomp': man.get('decomp'),
-                           'shards': man.get('shards')})
+        payload = {'key': man.get('key'), 'seq': man.get('seq'),
+                   'nranks': man.get('nranks'),
+                   'decomp': man.get('decomp'),
+                   'shards': man.get('shards')}
+        if 'quarantined' in man:
+            payload['quarantined'] = man['quarantined']
+        body = _canonical(payload)
         if _sha(body) != man.get('sha256'):
             counter('resilience.checkpoint.corrupt').add(1)
             return None
@@ -604,13 +710,31 @@ class FleetCheckpointStore(object):
             return None
         old = int(man['nranks'])
         seq = int(man['seq'])
+        quarantined = [int(r) for r in man.get('quarantined') or ()]
+        if quarantined:
+            # the sealed quarantine list is authoritative: adopt it,
+            # and a quarantined rank REFUSES to rejoin — the launcher
+            # must re-form the fleet without the suspect chip (the
+            # shrink-to-survive path below handles the smaller count)
+            suspect_tracker().adopt(quarantined)
+            if rank in quarantined:
+                counter('resilience.fleet.quarantine_refused').add(1)
+                raise RuntimeError(
+                    'fleet rank %d is quarantined in the sealed '
+                    'manifest %s.m%04d (integrity strikes); re-form '
+                    'the fleet without it' % (rank, key, seq))
         if nranks == old:
             got = self.store.load(self.shard_key(key, seq, rank))
             if got is None:
                 return None
             wrapped, arrays = got
-            return ((wrapped or {}).get('user'), arrays,
-                    {'seq': seq, 'nranks': old, 'reformed': False})
+            info = {'seq': seq, 'nranks': old, 'reformed': False}
+            if quarantined:
+                # mirror the manifest policy: the key appears only
+                # when there is something to report, so pre-integrity
+                # callers comparing info dicts never see it
+                info['quarantined'] = quarantined
+            return ((wrapped or {}).get('user'), arrays, info)
         per_rank = []
         for r in range(old):
             got = self.store.load(self.shard_key(key, seq, r))
@@ -624,9 +748,11 @@ class FleetCheckpointStore(object):
         if tr is not None:
             tr.event('resilience.fleet.reform',
                      {'key': str(key), 'from': old, 'to': nranks})
-        return (state, mine,
-                {'seq': seq, 'nranks': nranks, 'reformed': True,
-                 'reformed_from': old, 'reformed_to': nranks})
+        info = {'seq': seq, 'nranks': nranks, 'reformed': True,
+                'reformed_from': old, 'reformed_to': nranks}
+        if quarantined:
+            info['quarantined'] = quarantined
+        return (state, mine, info)
 
     # -- retention / observability ----------------------------------------
 

@@ -1,0 +1,343 @@
+"""Silent-data-corruption defense: tripwires, budgets, attribution.
+
+Every other piece of :mod:`nbodykit_tpu.resilience` handles *loud*
+failures — crashes, OOMs, preemptions, dead ranks.  Nothing before
+this module could detect a *wrong answer*: a flipped bit in an
+``all_to_all`` payload, a corrupted HBM line under a paint scatter, a
+degraded chip that silently skews P(k) for every tenant of the serve
+layer.  The defense is tiered (docs/INTEGRITY.md):
+
+**Tier 0 — cheap on-device invariants** (``set_options(
+integrity='cheap')``), priced as near-free reductions:
+
+- *mass conservation*: the deposit windows (CIC/TSC/PCS) distribute
+  each particle's mass over cells with weights summing to one, so
+  ``sum(field) == sum(mass)`` up to a compute-dtype rounding budget —
+  checked after every eager paint, for every registered kernel
+  including the bf16 streams path (whose storage rounding widens the
+  budget by the storage dtype's eps);
+- *Parseval*: for the unnormalized DFT,
+  ``sum(w*|X|^2) == Ntot * sum(x^2)`` with Hermitian weights ``w`` on
+  the compressed z axis — checked bracketing every eager
+  ``dist_rfftn``/``dist_irfftn`` (slab, pencil and single-device
+  alike, since the bracket sits at the public entry);
+- *NaN/Inf tripwires*: both invariants above are reductions, so a
+  non-finite mesh-sized intermediate poisons the reduced scalar and
+  trips the same check at zero extra cost;
+- *a2a fold checksums*: an ``all_to_all`` permutes a global payload
+  without changing its elements, so the globally-psummed fold
+  ``sum(|Re| + |Im|)`` is invariant across the wire.  Each of the 8
+  ``_a2a`` sites (parallel/dfft.py) compares the pre-wire fold
+  against the post-wire fold inside the shard_map — two extra psums,
+  identical on every rank (NBK103 by construction) — and the eager
+  driver raises on a mismatch.  The compressed wire formats are
+  checked *pre-quantization vs dequantized* against a budget the
+  format itself implies (bf16: mantissa width; int16: the per-shard
+  scale, psummed alongside).
+
+**Tier 1 — shadow verification** lives in :mod:`nbodykit_tpu.serve`:
+a completed request re-executes on a *different* sub-mesh worker and
+the results are compared — bit-identical for uncompressed postures,
+margin-gated (PRECISION.json) for compressed ones.
+
+**Tier 2 — attribution and quarantine**: every violation raises a
+classified :class:`IntegrityError` carrying (site, rank, delta).  The
+Supervisor (:mod:`.supervise`) retries it exactly once — a transient
+bit flip heals, a sick chip doesn't — and each strike lands in the
+:class:`~nbodykit_tpu.resilience.fleet.SuspectTracker`, which
+quarantines a rank after K strikes into the sealed fleet manifest.
+
+``integrity='off'`` (the default) adds ZERO ops — every guard
+resolves the mode at closure-build/dispatch time and compiles or
+executes nothing when off, so results are bit-identical to a build
+without this module.
+"""
+
+import math
+import os
+import threading
+
+from ..diagnostics import counter, current_tracer
+
+_lock = threading.Lock()
+_violations = []
+
+
+class IntegrityError(RuntimeError):
+    """A detected integrity violation, classified for attribution.
+
+    Parameters
+    ----------
+    site : str — the guard that fired (``paint.mass``, ``fft.parseval``,
+        ``a2a.checksum``, ``serve.shadow``, ``*.nonfinite``)
+    rank : int or None — the fleet rank the violation was observed on
+    delta : float or None — the invariant's residual (absolute)
+    detail : str or None — extra context for the record
+    """
+
+    def __init__(self, site, rank=None, delta=None, detail=None):
+        self.site = str(site)
+        self.rank = rank
+        self.delta = delta
+        msg = 'DATA_CORRUPTION: integrity violation at %s' % self.site
+        if rank is not None:
+            msg += ' (rank %d)' % int(rank)
+        if delta is not None:
+            msg += ' delta=%.6g' % float(delta)
+        if detail:
+            msg += ': %s' % detail
+        super(IntegrityError, self).__init__(msg)
+
+
+def integrity_mode():
+    """The resolved ``integrity`` option: 'off' or 'cheap'."""
+    try:
+        from .. import _global_options
+        v = _global_options['integrity']
+    except Exception:        # pragma: no cover - interpreter teardown
+        return 'off'
+    if v in (None, False, '', 'off'):
+        return 'off'
+    if v in (True, 'on', 'cheap'):
+        return 'cheap'
+    raise ValueError("integrity must be 'off' or 'cheap' (got %r)" % v)
+
+
+def checks_enabled():
+    """Whether tier-0 guards should run (the one call every guarded
+    surface makes at dispatch time — False compiles/executes nothing)."""
+    return integrity_mode() != 'off'
+
+
+# ---------------------------------------------------------------------------
+# the violation ledger
+
+def violation(site, rank=None, delta=None, detail=None):
+    """Record a violation (ledger + counter + trace event) and return
+    the classified :class:`IntegrityError` for the caller to raise."""
+    if rank is None:
+        from .fleet import fleet_rank
+        rank = fleet_rank()
+    rec = {'site': str(site), 'rank': int(rank),
+           'delta': None if delta is None else float(delta)}
+    if detail:
+        rec['detail'] = str(detail)[:200]
+    with _lock:
+        _violations.append(rec)
+    counter('integrity.violations').add(1)
+    tr = current_tracer()
+    if tr is not None:
+        tr.event('integrity.violation', rec)
+    return IntegrityError(site, rank=rank, delta=delta, detail=detail)
+
+
+def violation_counts():
+    """Snapshot: total violations plus a per-site breakdown."""
+    with _lock:
+        recs = list(_violations)
+    by_site = {}
+    for r in recs:
+        by_site[r['site']] = by_site.get(r['site'], 0) + 1
+    return {'violations': len(recs), 'by_site': by_site,
+            'records': recs}
+
+
+def reset_integrity():
+    """Clear the violation ledger (test isolation)."""
+    with _lock:
+        del _violations[:]
+
+
+# ---------------------------------------------------------------------------
+# budgets
+
+def rel_budget(dtype, n):
+    """The compute-dtype-derived relative tolerance for a reduction
+    over ``n`` terms: ``64 * eps * sqrt(n)`` (floored at 8 terms) —
+    the random-walk rounding model with a wide safety factor.  Bit
+    flips in sign/exponent shift a reduced scalar by orders of
+    magnitude; this budget is what separates them from legitimate
+    tree-reduction reordering noise."""
+    import numpy as np
+    eps = float(np.finfo(np.dtype(dtype)).eps)
+    return 64.0 * eps * max(8.0, math.sqrt(float(max(int(n), 1))))
+
+
+def mass_budget(n, compute_dtype, storage_dtype=None):
+    """Relative budget for the paint mass-conservation check: the
+    compute-dtype reduction budget, widened by the storage dtype's eps
+    when the mesh stores narrow (bf16 deposits round each term once —
+    a deterministic, bounded, non-cancelling error the guard must
+    tolerate while still catching corruption)."""
+    import numpy as np
+    b = rel_budget(compute_dtype, n)
+    if storage_dtype is not None:
+        from ..utils import is_narrow_float
+        if is_narrow_float(storage_dtype):
+            import jax.numpy as jnp
+            b += 8.0 * float(jnp.finfo(jnp.dtype(storage_dtype)).eps)
+    return b
+
+
+_MARGIN_FALLBACK = {'a2a-bf16': 0.01, 'a2a-int16': 0.0005,
+                    'mesh-bf16': 0.02}
+_margins_cache = None
+
+
+def precision_margins():
+    """The committed PRECISION.json accuracy margins (budget per
+    compressed posture), falling back to the documented defaults when
+    the file is absent (installed package, detached worker)."""
+    global _margins_cache
+    if _margins_cache is not None:
+        return _margins_cache
+    out = dict(_MARGIN_FALLBACK)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), 'PRECISION.json')
+    try:
+        import json
+        with open(path) as f:
+            data = json.load(f)
+        for k, v in (data.get('margins') or {}).items():
+            if isinstance(v, dict) and 'budget' in v:
+                out[k] = float(v['budget'])
+    except Exception:
+        pass
+    _margins_cache = out
+    return out
+
+
+def shadow_margin(options=None):
+    """The result-comparison margin for tier-1 shadow verification: 0
+    (bit-identical required) for uncompressed postures, else the sum
+    of the PRECISION.json budgets of every compressed knob in play."""
+    from .. import _global_options
+    opts = dict(_global_options.copy())
+    opts.update(options or {})
+    m = precision_margins()
+    margin = 0.0
+    if str(opts.get('a2a_compress') or 'none') == 'bf16':
+        margin += m['a2a-bf16']
+    elif str(opts.get('a2a_compress') or 'none') == 'int16':
+        margin += m['a2a-int16']
+    if str(opts.get('mesh_dtype') or 'f4') == 'bf16':
+        margin += m['mesh-bf16']
+    return margin
+
+
+# ---------------------------------------------------------------------------
+# tier-0 checks (host-side, eager — called with concrete floats)
+
+def check_close(site, got, want, budget_rel, rank=None, detail=None):
+    """The shared invariant comparator: raises a recorded
+    :class:`IntegrityError` when ``|got - want|`` exceeds the relative
+    budget, or when either side is non-finite (the NaN/Inf tripwire —
+    a poisoned mesh-sized intermediate reduces to a poisoned scalar)."""
+    got, want = float(got), float(want)
+    if not (math.isfinite(got) and math.isfinite(want)):
+        raise violation(site + '.nonfinite', rank=rank,
+                        detail='got=%r want=%r' % (got, want))
+    delta = abs(got - want)
+    if delta > max(abs(want), 1.0) * float(budget_rel):
+        raise violation(
+            site, rank=rank, delta=delta,
+            detail='got=%.9g want=%.9g budget_rel=%.3g%s'
+                   % (got, want, budget_rel,
+                      ' (%s)' % detail if detail else ''))
+    return delta
+
+
+def check_mass(site, total, expected, scale, n, compute_dtype,
+               storage_dtype=None):
+    """Paint mass conservation: the deposited field's global sum must
+    equal the global deposited mass within :func:`mass_budget`.
+    ``scale`` is the absolute-mass fold ``sum(|mass|)`` the rounding
+    budget scales with — signed weights (FKP) can cancel in
+    ``expected`` while the rounding error cannot."""
+    total, expected = float(total), float(expected)
+    if not (math.isfinite(total) and math.isfinite(expected)):
+        raise violation(site + '.nonfinite',
+                        detail='total=%r expected=%r'
+                               % (total, expected))
+    budget = max(abs(float(scale)), 1.0) * mass_budget(
+        n, compute_dtype, storage_dtype)
+    delta = abs(total - expected)
+    if delta > budget:
+        raise violation(site, delta=delta,
+                        detail='total=%.9g expected=%.9g budget=%.3g'
+                               % (total, expected, budget))
+    return delta
+
+
+def check_a2a(site, pre, post, budget_abs, rank=None):
+    """All_to_all fold-checksum: the globally-psummed fold of the
+    payload must be wire-invariant within the format's own budget
+    (computed in-graph alongside the folds — see dfft._a2a_checked)."""
+    pre, post, budget = float(pre), float(post), float(budget_abs)
+    if not (math.isfinite(pre) and math.isfinite(post)):
+        raise violation(site + '.nonfinite', rank=rank,
+                        detail='pre=%r post=%r' % (pre, post))
+    delta = abs(pre - post)
+    if delta > max(budget, 1e-30):
+        raise violation(site, rank=rank, delta=delta,
+                        detail='pre=%.9g post=%.9g budget=%.3g'
+                               % (pre, post, budget))
+    return delta
+
+
+# ---------------------------------------------------------------------------
+# deterministic payload corruption (the testable SDC stand-in)
+
+def flip_bits_value(x, nbits):
+    """Apply a stuck-at-one fault to the top ``nbits`` bits below the
+    sign of one float32 word — the deterministic corruption the
+    ``corrupt[:bits]`` fault action injects.  The mask always covers
+    the exponent's top two bits, so ANY finite input (including 0.0)
+    lands at magnitude >= 2**65: an XOR flip of a near-zero or large
+    element can move a fold checksum by *less* than its legitimate
+    rounding budget, which would make detection input-dependent — a
+    stuck-at-one exponent fault is catastrophic by construction, so
+    the detection matrix is deterministic.  Works under trace and
+    eagerly (pure jnp)."""
+    import jax
+    import jax.numpy as jnp
+    word = jax.lax.bitcast_convert_type(
+        jnp.asarray(x, jnp.float32), jnp.uint32)
+    nbits = max(1, min(int(nbits), 30))
+    mask = jnp.uint32((((1 << nbits) - 1) << (31 - nbits))
+                      | 0x60000000)
+    return jax.lax.bitcast_convert_type(word | mask, jnp.float32)
+
+
+def corrupt_real(arr, nbits):
+    """Flip bits in element [0, ...] of a real array (eager or traced);
+    returns the corrupted array in the input dtype."""
+    import jax.numpy as jnp
+    flat = arr.reshape(-1)
+    bad = flip_bits_value(flat[0], nbits).astype(flat.dtype)
+    return flat.at[0].set(bad).reshape(arr.shape)
+
+
+def corrupt_host(arr, nbits):
+    """The numpy form of :func:`corrupt_real` for host-side results
+    (the ``serve.result`` injection point flips a delivered spectrum
+    AFTER compute, so only tier-1 shadow verification can catch it).
+    Returns a float32 copy with element [0] stuck-at-one faulted."""
+    import numpy as np
+    out = np.array(arr, dtype=np.float32, copy=True)
+    nbits = max(1, min(int(nbits), 30))
+    mask = np.uint32((((1 << nbits) - 1) << (31 - nbits)) | 0x60000000)
+    flat = out.reshape(-1)
+    word = flat[:1].view(np.uint32)
+    word |= mask
+    return out
+
+
+def corrupt_complex(y, nbits):
+    """Flip bits in the real part of element [0, ...] of a complex
+    payload (used on the a2a wire)."""
+    import jax
+    import jax.numpy as jnp
+    r, i = jnp.real(y), jnp.imag(y)
+    return jax.lax.complex(corrupt_real(r, nbits).astype(r.dtype),
+                           i).astype(y.dtype)

@@ -33,6 +33,7 @@ from .faults import fault_point
 TRANSIENT = 'transient'
 OOM = 'oom'
 DEADLINE = 'deadline'
+INTEGRITY = 'integrity'
 FATAL = 'fatal'
 
 # gRPC-status / runtime substrings, checked in order: OOM first, since
@@ -47,15 +48,20 @@ _TRANSIENT_MARKERS = ('UNAVAILABLE', 'DATA_LOSS', 'socket closed',
 
 
 def classify_error(exc):
-    """One of TRANSIENT / OOM / DEADLINE / FATAL for a raised error.
+    """One of TRANSIENT / OOM / DEADLINE / INTEGRITY / FATAL for a
+    raised error.
 
     Classification is by message substring — the runtime's gRPC status
     prefixes (``UNAVAILABLE: ...``) survive every re-wrap the stack
     applies, while the exception *types* do not (XlaRuntimeError covers
-    all of them).  ``MemoryError`` is OOM regardless of text."""
+    all of them).  ``MemoryError`` is OOM regardless of text;
+    integrity violations carry the ``DATA_CORRUPTION:`` prefix
+    (resilience/integrity.py) through the same discipline."""
     if isinstance(exc, MemoryError):
         return OOM
     text = str(exc)
+    if 'DATA_CORRUPTION' in text:
+        return INTEGRITY
     for marker in _OOM_MARKERS:
         if marker in text:
             return OOM
@@ -222,7 +228,8 @@ class Supervisor(object):
     # counter name (plural) -> trace event span name
     _EVENT_SPANS = {'retries': 'resilience.retry',
                     'degradations': 'resilience.degrade',
-                    'resumes': 'resilience.resume'}
+                    'resumes': 'resilience.resume',
+                    'integrity_retries': 'resilience.integrity_retry'}
 
     def _event(self, kind, **attrs):
         attrs['task'] = self.name
@@ -266,9 +273,13 @@ class Supervisor(object):
     def run(self, fn, *args, **kwargs):
         """Call ``fn(*args, **kwargs)`` under the per-class policy:
         bounded backoff retries for TRANSIENT/DEADLINE, ladder
-        degradation for OOM, immediate re-raise for FATAL (and for
-        exhausted budgets/ladders)."""
+        degradation for OOM, exactly-one retry for INTEGRITY (a
+        transient bit flip heals on re-execution; a sick chip fails
+        again, and every strike lands in the fleet's SuspectTracker
+        either way), immediate re-raise for FATAL (and for exhausted
+        budgets/ladders)."""
         retries = 0
+        integrity_retried = False
         while True:
             try:
                 # inside the try: injected faults at the attempt point
@@ -277,6 +288,22 @@ class Supervisor(object):
                 return fn(*args, **kwargs)
             except Exception as e:
                 kind = classify_error(e)
+                if kind == INTEGRITY:
+                    # attribution first: the strike is recorded whether
+                    # or not the retry heals, so a chip that corrupts
+                    # once per K tasks still accumulates toward
+                    # quarantine (resilience/fleet.py)
+                    from .fleet import suspect_tracker
+                    rank = getattr(e, 'rank', None)
+                    site = getattr(e, 'site', 'unknown')
+                    suspect_tracker().strike(rank, site=site,
+                                             task=self.name)
+                    if integrity_retried:
+                        raise
+                    integrity_retried = True
+                    self._event('integrity_retries', site=site,
+                                rank=rank, error=str(e)[:200])
+                    continue
                 if kind == OOM:
                     rung = self.ladder.step() if self.ladder is not None \
                         else None

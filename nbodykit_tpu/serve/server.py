@@ -109,9 +109,10 @@ class RequestResult(object):
 
 class _Ticket(object):
     __slots__ = ('request', 'decision', 'submitted_at', 'deadline_at',
-                 'seq', 'affinity', 'done', 'result')
+                 'seq', 'affinity', 'done', 'result', 'verify')
 
-    def __init__(self, request, decision, submitted_at, seq, aff):
+    def __init__(self, request, decision, submitted_at, seq, aff,
+                 verify=False):
         self.request = request
         self.decision = decision
         self.submitted_at = submitted_at
@@ -120,6 +121,7 @@ class _Ticket(object):
         self.affinity = aff
         self.done = threading.Event()
         self.result = None
+        self.verify = bool(verify)
 
 
 class AnalysisServer(object):
@@ -137,10 +139,25 @@ class AnalysisServer(object):
     checkpoint : :class:`~nbodykit_tpu.resilience.CheckpointStore`
         or None — per-request resume across mid-run faults
     retry : :class:`~nbodykit_tpu.resilience.RetryPolicy` override
+    verify_fraction : float in [0, 1] — deterministically sample this
+        fraction of admitted seeded requests for tier-1 shadow
+        verification (docs/INTEGRITY.md), on top of any request that
+        sets ``verify=True`` itself.  A shadowed request re-executes
+        on a different sub-mesh worker after completion and the
+        results are compared — bit-identical when no lossy
+        compression is in play, within :func:`~nbodykit_tpu.resilience
+        .integrity.shadow_margin` otherwise.  A mismatch raises a
+        classified IntegrityError, so the per-request Supervisor
+        retries it once and the strike lands in the SuspectTracker.
+        The shadow run needs no extra admission headroom: it executes
+        the SAME priced program on the shadow worker's identical
+        sub-mesh, so the request's memory_plan verdict bounds both
+        executions.
     """
 
     def __init__(self, per_task=1, max_queue=256, hbm_bytes=16e9,
-                 batch=None, checkpoint=None, retry=None):
+                 batch=None, checkpoint=None, retry=None,
+                 verify_fraction=0.0):
         from ..batch import TaskManager
         from ..parallel.runtime import (CurrentMesh, cpu_mesh,
                                         tpu_mesh, use_mesh)
@@ -162,6 +179,9 @@ class AnalysisServer(object):
         self.batch = batch if batch is not None else BatchPolicy()
         self.checkpoint = checkpoint
         self.retry = retry
+        self.verify_fraction = min(max(float(verify_fraction), 0.0),
+                                   1.0)
+        self._shadow = {'verified': 0, 'mismatch': 0}
         self.programs = ProgramCache()
         # one content-addressed catalog cache per sub-mesh worker:
         # repeat data_ref requests against a survey route (via the
@@ -316,11 +336,29 @@ class AnalysisServer(object):
         ticket = None
         with self._cv:
             self._seq += 1
-            ticket = _Ticket(request, decision, now, self._seq, aff)
+            ticket = _Ticket(request, decision, now, self._seq, aff,
+                             verify=self._should_verify(request))
             self._pending.append(ticket)
             gauge('serve.queue_depth').set(len(self._pending))
             self._cv.notify_all()
         return ticket
+
+    def _should_verify(self, request):
+        """Whether this request gets a tier-1 shadow run: opted in via
+        ``request.verify``, or deterministically sampled (a stable
+        hash of the request id, not a PRNG — the same request stream
+        shadows the same requests on every replay, so admission-level
+        A/B comparisons stay reproducible).  data_ref requests never
+        shadow (re-ingestion is not a cheap re-execution)."""
+        if getattr(request, 'data_ref', None) is not None:
+            return False
+        if getattr(request, 'verify', False):
+            return True
+        if self.verify_fraction <= 0.0:
+            return False
+        import zlib
+        h = zlib.crc32(request.request_id.encode('utf-8')) % 10000
+        return h < self.verify_fraction * 10000.0
 
     def _reject_now(self, request, now, reason, decision=None):
         counter('serve.rejected').add(1)
@@ -395,10 +433,14 @@ class AnalysisServer(object):
 
     def _batchable(self, ticket):
         # data_ref requests never batch: their input is a streamed
-        # catalog, not a seed vmap can widen over
+        # catalog, not a seed vmap can widen over.  Shadow-verified
+        # tickets never batch either: the shadow re-run and compare
+        # are per-request, and one suspect member must not force a
+        # whole batch through a second execution.
         return (self.ndevices == 1
                 and ticket.request.algorithm == 'FFTPower'
                 and ticket.request.data_ref is None
+                and not ticket.verify
                 and not ticket.decision.options)
 
     def _collect_locked(self, leader, opened_at):
@@ -488,6 +530,15 @@ class AnalysisServer(object):
                     out = prog.run(padded)[:n]
                 else:
                     out = prog.run(seeds)
+                # the serve.result data-injection point sits HERE —
+                # after compute, before verification and checkpoint —
+                # so only the tier-1 shadow compare can catch it
+                out = self._result_corrupt_point(out)
+                if leader.verify:
+                    # verify BEFORE sup.save: a corrupted result must
+                    # never be checkpointed, or the retry would resume
+                    # it instead of recomputing clean
+                    self._shadow_verify(req, out, seeds, opts, wi)
             import numpy as np
             sup.save(rid, {'seeds': list(seeds)},
                      arrays={'x': np.array([o[0] for o in out]),
@@ -570,6 +621,74 @@ class AnalysisServer(object):
                 algorithm=t.request.algorithm,
                 shape_class=t.request.shape_class))
 
+    # -- tier-1 shadow verification ---------------------------------------
+
+    def _result_corrupt_point(self, out):
+        """The ``serve.result`` data-injection point: flip bits in the
+        delivered spectrum of the first result when a ``corrupt`` rule
+        fires (chaos grammar, docs/INTEGRITY.md).  The corruption is
+        applied to the REAL result the shadow compare judges — the
+        detector is what gets tested, not the injector."""
+        from ..resilience.faults import corrupt_spec
+        bits = corrupt_spec('serve.result')
+        if not bits:
+            return out
+        from ..resilience.integrity import corrupt_host
+        x, y, nm = out[0]
+        return [(x, corrupt_host(y, bits), nm)] + list(out[1:])
+
+    def _shadow_verify(self, req, out, seeds, opts, wi):
+        """Re-execute ``req`` on the next sub-mesh worker's devices
+        and compare against ``out``.  Uncompressed postures must match
+        bit-for-bit (same XLA program, same backend — any divergence
+        is hardware or wire corruption); compressed postures are
+        judged against :func:`~nbodykit_tpu.resilience.integrity
+        .shadow_margin`.  A mismatch raises a recorded
+        IntegrityError(``serve.shadow``), which the per-request
+        Supervisor classifies, strikes, and retries exactly once."""
+        import numpy as np
+        from ..resilience.integrity import shadow_margin, violation
+        swi = (wi + 1) % len(self.meshes)
+        sprog = self.programs.get(req, self.meshes[swi], swi,
+                                  opts=opts)
+        if sprog.batchable:
+            padded, n = pad_seeds(seeds)
+            ref = sprog.run(padded)[:n]
+        else:
+            ref = sprog.run(seeds)
+        margin = shadow_margin(opts)
+        counter('serve.shadow.verified').add(1)
+        with self._lock:
+            self._shadow['verified'] += 1
+        for (x1, y1, n1), (x2, y2, n2) in zip(out, ref):
+            delta, bad = None, None
+            if not np.array_equal(np.asarray(x1), np.asarray(x2)) \
+                    or not np.array_equal(np.asarray(n1),
+                                          np.asarray(n2)):
+                bad = 'bin geometry diverged'
+            else:
+                a = np.asarray(y1, np.float64)
+                b = np.asarray(y2, np.float64)
+                if margin <= 0.0:
+                    if not np.array_equal(a, b):
+                        delta = float(np.max(np.abs(a - b)))
+                        bad = 'bit-identical required'
+                else:
+                    scale = max(float(np.max(np.abs(b))), 1e-30)
+                    delta = float(np.max(np.abs(a - b))) / scale
+                    if delta > margin:
+                        bad = 'relative margin %.3g exceeded' % margin
+                    else:
+                        delta, bad = None, None
+            if bad is not None:
+                counter('serve.shadow.mismatch').add(1)
+                with self._lock:
+                    self._shadow['mismatch'] += 1
+                raise violation(
+                    'serve.shadow', delta=delta,
+                    detail='%s (request %s, worker %d vs shadow %d)'
+                           % (bad, req.request_id, wi, swi))
+
     # -- reporting --------------------------------------------------------
 
     @staticmethod
@@ -593,6 +712,7 @@ class AnalysisServer(object):
             submitted = self._submitted
             queued = len(self._pending)
             inflight = self._inflight
+            shadow = dict(self._shadow)
         by_status = {}
         for r in results:
             by_status[r.status] = by_status.get(r.status, 0) + 1
@@ -651,6 +771,15 @@ class AnalysisServer(object):
                 float(e.get('bytes') or 0)
                 for e in ingest_events) / 1e9, 6),
             'ingest_cache': cat,
+            # the tier-1 integrity posture (docs/INTEGRITY.md):
+            # shadowed runs, mismatches caught, and how many requests
+            # recovered through the Supervisor's one integrity retry —
+            # the doctor FAILs when mismatches outnumber recoveries
+            'shadow_verified': shadow['verified'],
+            'shadow_mismatch': shadow['mismatch'],
+            'integrity_retried': sum(
+                1 for r in results
+                if r.event_count('integrity_retries')),
             'by_class': {k: {'n': len(v),
                              'p50_s': self._pctile(v, 0.50),
                              'p99_s': self._pctile(v, 0.99)}

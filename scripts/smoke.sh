@@ -306,6 +306,49 @@ print('ingest gate OK: 2 data_ref requests completed, 1 cache hit, '
       'bit-equal P(k), lost=0')
 EOF
 
+# data-integrity gate (docs/INTEGRITY.md): a mesh64 FFT bench under
+# integrity='cheap' with one stuck-at-one corruption injected into an
+# all_to_all payload — the wire checksum must catch it, the supervisor
+# retries once against the strike ledger, and the record is stamped
+# integrity: {violations: 1, retried: 1}
+echo "== integrity gate (mesh64, injected a2a corruption) =="
+env JAX_NUM_CPU_DEVICES=8 NBKIT_FAULTS='a2a.payload@1:corrupt' \
+    python bench.py --integrity 64 100000 2 > "$SMOKE_TMP/integ.json"
+python - "$SMOKE_TMP" <<'EOF'
+import json, os, sys
+rec = json.loads(open(os.path.join(
+    sys.argv[1], 'integ.json')).read().strip().splitlines()[-1])
+assert rec.get('integrity') == {'violations': 1, 'retried': 1}, rec
+assert rec.get('value', -1) > 0 and rec.get('unit') == 's', rec
+print('integrity gate OK: 1 injected corruption caught at %s, '
+      'retried clean, overhead %.1f%%' % (
+          ','.join(rec.get('violation_sites', ['?'])),
+          100.0 * rec.get('overhead', 0.0)))
+EOF
+
+# shadow-verification gate (docs/INTEGRITY.md): a seeded request with
+# verify=True is re-executed on the OTHER sub-mesh after completing —
+# the uncompressed program must come back bit-identical, proving two
+# disjoint device groups agree on the full pipeline
+echo "== shadow verification gate (verify=True, 2 sub-meshes) =="
+python - <<'EOF'
+from nbodykit_tpu._jax_compat import set_cpu_devices
+set_cpu_devices(8)
+import jax
+jax.config.update('jax_enable_x64', True)
+from nbodykit_tpu.serve import COMPLETED, AnalysisRequest, AnalysisServer
+with AnalysisServer(per_task=4) as srv:
+    assert len(srv.meshes) >= 2, srv.meshes
+    r = srv.wait(srv.submit(AnalysisRequest(
+        nmesh=32, npart=2000, seed=3, verify=True, deadline_s=600.0)))
+    summary = srv.summary()
+assert r.status == COMPLETED, r
+assert summary['shadow_verified'] == 1, summary
+assert summary['shadow_mismatch'] == 0, summary
+print('shadow gate OK: 1 request shadow-verified bit-identical '
+      'across sub-meshes, 0 mismatches')
+EOF
+
 # the rule-tree-produced PartitionSpecs cross shard_map boundaries in
 # the paint path; the sharding-flow analyses must stay clean over the
 # whole surface with nothing new and nothing grandfathered (the

@@ -306,6 +306,71 @@ def test_bigfile_read_range_validated(tmp_path):
         ds.read(7, 3)
 
 
+def test_bigfile_checksum_detects_corruption(tmp_path):
+    """A flipped byte on disk raises ChecksumMismatch on the first
+    read touching the file — naming the column and both checksums —
+    instead of feeding rotten bytes to a catalog
+    (docs/INTEGRITY.md)."""
+    import nbodykit_tpu
+    from nbodykit_tpu.io.bigfile import BigFileWriter, BigFileDataset
+
+    path = str(tmp_path / 'rot')
+    data = np.arange(300, dtype='f8').reshape(100, 3)
+    with BigFileWriter(path) as bf:
+        bf.write('Position', data, nfile=2)
+
+    # corrupt one byte of the SECOND physical file
+    fn = str(tmp_path / 'rot' / 'Position' / '000001')
+    with open(fn, 'r+b') as ff:
+        ff.seek(8)
+        b = ff.read(1)
+        ff.seek(8)
+        ff.write(bytes([b[0] ^ 0xFF]))
+
+    ds = BigFileDataset(path, 'Position')
+    # rows wholly inside the intact first file read fine (lazy,
+    # per-file verification)
+    np.testing.assert_array_equal(ds.read(0, 10), data[:10])
+    with pytest.raises(nio.ChecksumMismatch) as ei:
+        ds.read(0, 100)
+    assert ei.value.column == 'Position'
+    assert ei.value.expected != ei.value.got
+    assert 'io_verify_checksums' in str(ei.value)
+
+    # explicit opt-out loads the bytes as-is (restore-and-inspect)
+    with nbodykit_tpu.set_options(io_verify_checksums=False):
+        ds2 = BigFileDataset(path, 'Position')
+        out = ds2.read(0, 100)
+    assert out.shape == data.shape
+    assert not np.array_equal(out, data)
+
+
+def test_bigfile_legacy_header_skips_verification(tmp_path):
+    """Headers whose entries carry no checksum field (foreign writers)
+    must load unverified rather than fail."""
+    from nbodykit_tpu.io.bigfile import BigFileWriter, BigFileDataset
+
+    path = str(tmp_path / 'legacy')
+    data = np.arange(30, dtype='f8')
+    with BigFileWriter(path) as bf:
+        bf.write('X', data, nfile=1)
+    hdr = str(tmp_path / 'legacy' / 'X' / 'header')
+    with open(hdr) as ff:
+        lines = ff.read().splitlines()
+    # strip the checksum field from the per-file entries ('%06X: n :
+    # cks' -> '%06X: n'), leaving DTYPE/NMEMB/NFILE untouched
+    with open(hdr, 'w') as ff:
+        for line in lines:
+            parts = line.split(':')
+            if len(parts) == 3 and parts[0].strip() not in (
+                    'DTYPE', 'NMEMB', 'NFILE'):
+                line = '%s: %s' % (parts[0], parts[1].strip())
+            ff.write(line + '\n')
+    ds = BigFileDataset(path, 'X')
+    assert ds.checksums.get(0) is None
+    np.testing.assert_array_equal(ds.read(0, 30), data)
+
+
 def test_csv_reader_kwargs(tmp_path):
     """CSV variations the reference exercises (io/tests/test_csv.py):
     comma separator, comments, blank lines, usecols, skiprows,
