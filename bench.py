@@ -1113,6 +1113,148 @@ def run_serve_trace(n=1000, per_task=1, max_batch=8, seed=0):
     return _stamp(rec)
 
 
+def run_region_trace(n=200, fleets=2, per_task=1, seed=0):
+    """The multi-fleet region round: replay a deterministic
+    ``n``-item multi-tenant trace (per-tenant Zipf shapes, a
+    repeat-request slice, a scripted mid-trace host arrival) through
+    a live :class:`~nbodykit_tpu.serve.Region` fronting ``fleets``
+    independent AnalysisServers, and report the full region posture:
+
+    - **result cache**: hit count / hit rate, and a bit-identity
+      check — one cached spectrum compared element-exact against a
+      fresh recomputation on a virgin server;
+    - **routing**: verdict counts (affinity / spill / catalog_home /
+      rerouted_dead), with ≥1 structured spill expected under the
+      closed-loop slam;
+    - **elastic**: the mid-trace join, with the membership manifest's
+      ``reformed_from``/``reformed_to`` stamps read back from disk;
+    - **QoS**: per-class p50/p99 with the bulk tenant flooding at
+      self-declared priority 2 — fair share holds (throttled > 0)
+      and interactive requests stay unstarved (starved == 0);
+    - ``lost == 0`` and ``unverified_as_verified == 0``, the two
+      numbers the doctor FAILs on.
+
+    ``value`` is the interactive-class p99 seconds — the number a
+    bulk flood would inflate without fair share — lower is better."""
+    jax = _setup_jax()
+    import tempfile
+    import numpy as np
+    from nbodykit_tpu.parallel.runtime import cpu_mesh, use_mesh
+    from nbodykit_tpu.resilience.faults import reset_faults
+    from nbodykit_tpu.resilience.fleet import FleetCheckpointStore
+    from nbodykit_tpu.serve import (AnalysisServer, QoSPolicy, Region,
+                                    ResultCache, ServiceClass,
+                                    generate_region_trace,
+                                    replay_region)
+
+    ndev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    rec = {"metric": "regiontrace_n%d" % n, "unit": "s",
+           "platform": platform, "requests": n, "fleets": fleets,
+           "per_task": per_task, "seed": seed,
+           "faults_spec": os.environ.get('NBKIT_FAULTS', '')}
+    reset_faults()
+
+    def _fleet():
+        # each fleet is an independent server; on CPU every fleet
+        # fronts a 1-device sub-mesh (oversubscribing the host is
+        # fine — the bench measures region mechanics, not FLOPs)
+        if platform == 'cpu':
+            with use_mesh(cpu_mesh(1)):
+                return AnalysisServer(per_task=per_task,
+                                      max_queue=max(n, 16))
+        return AnalysisServer(per_task=per_task,
+                              max_queue=max(n, 16))
+
+    tmp = tempfile.mkdtemp(prefix='nbkit-region-')
+    store = FleetCheckpointStore(os.path.join(tmp, 'ckpt'))
+    qos = QoSPolicy(
+        classes=[ServiceClass('interactive'),
+                 ServiceClass('bulk', rate=16.0, burst=8)],
+        tenants={'bulk-sweep': 'bulk'},
+        default_class='interactive')
+    region = Region([('fleet-%d' % i, _fleet())
+                     for i in range(int(fleets))],
+                    result_cache=ResultCache(
+                        os.path.join(tmp, 'results')),
+                    qos=qos, spill_depth=2, checkpoint=store)
+    trace = generate_region_trace(n, seed=seed, deadline_s=600.0,
+                                  join_at=0.5)
+    joins = []
+
+    def _arrive(reg):
+        joins.append(reg.join(_fleet()))
+
+    t0 = time.time()
+    replay_region(region, trace, seed=seed, on_join=_arrive)
+    region.drain(timeout=600)
+    # bit-identity: one cached spectrum vs a fresh recomputation on
+    # a virgin single-fleet server (same request, zero shared state)
+    probe = next((item['request'] for item in trace
+                  if 'request' in item
+                  and region.results.get(
+                      item['request'].request_id) is not None
+                  and region.results[
+                      item['request'].request_id].ok), None)
+    identical = None
+    if probe is not None:
+        from nbodykit_tpu.serve import AnalysisRequest
+        cached = region.results[probe.request_id]
+        srv = _fleet()
+        fresh = srv.wait(srv.submit(AnalysisRequest.from_dict(
+            dict(probe.to_dict(), request_id='region-bitcheck'))),
+            timeout=300)
+        srv.shutdown()
+        identical = bool(
+            fresh is not None and fresh.ok
+            and np.array_equal(np.asarray(cached.y),
+                               np.asarray(fresh.y))
+            and np.array_equal(np.asarray(cached.nmodes),
+                               np.asarray(fresh.nmodes)))
+    summary = region.summary()
+    region.shutdown()
+    rec['wall_s'] = round(time.time() - t0, 3)
+    for key in ('submitted', 'resolved', 'completed', 'rejected',
+                'evicted', 'lost', 'fleet_count'):
+        rec[key] = summary[key]
+    cache = summary['result_cache'] or {}
+    rec['result_hits'] = cache.get('hits', 0)
+    rec['hit_rate'] = cache.get('hit_rate')
+    rec['cache_corrupt'] = cache.get('corrupt', 0)
+    rec['unverified_as_verified'] = cache.get('unverified_as_verified',
+                                              0)
+    rec['cache_bit_identical'] = identical
+    routed = summary['routed']
+    rec['routed'] = routed
+    rec['spills'] = routed.get('spill', 0)
+    rec['joins'] = summary['elastic']['joins']
+    rec['rehomed'] = summary['elastic']['rehomed']
+    man = store.latest_manifest('region')
+    rec['reformed_from'] = man.get('reformed_from') if man else None
+    rec['reformed_to'] = man.get('reformed_to') if man else None
+    rec['throttled'] = summary['qos']['throttled']
+    rec['starved'] = summary['qos']['starved']
+    rec['table'] = summary['by_class']
+    inter = summary['by_class'].get('interactive', {})
+    rec['interactive_p50_s'] = inter.get('p50_s')
+    rec['interactive_p99_s'] = inter.get('p99_s')
+    errs = []
+    if summary['lost']:
+        errs.append('%d request(s) lost without a structured verdict'
+                    % summary['lost'])
+    if rec['unverified_as_verified']:
+        errs.append('%d unverified cache hit(s) served as verified'
+                    % rec['unverified_as_verified'])
+    if identical is False:
+        errs.append('cached result NOT bit-identical to '
+                    'recomputation')
+    if errs:
+        rec['error'] = '; '.join(errs)
+    rec['value'] = rec['interactive_p99_s'] \
+        if rec['interactive_p99_s'] is not None else -1.0
+    return _stamp(rec)
+
+
 def run_ingest(npart=400000, nmesh=64, chunk_rows=None, seed=0):
     """The ingestion-plane round: stream an on-disk catalog onto the
     device mesh (nbodykit_tpu.ingest, docs/INGEST.md) and measure the
@@ -1986,6 +2128,13 @@ if __name__ == '__main__':
             int(argv[1]) if argv[1:] else 1000,
             per_task=int(argv[2]) if argv[2:] else 1,
             max_batch=int(argv[3]) if argv[3:] else 8,
+            seed=int(argv[4]) if argv[4:] else 0)))
+        sys.exit(0)
+    if argv[0] == '--region-trace':
+        print(json.dumps(run_region_trace(
+            int(argv[1]) if argv[1:] else 200,
+            fleets=int(argv[2]) if argv[2:] else 2,
+            per_task=int(argv[3]) if argv[3:] else 1,
             seed=int(argv[4]) if argv[4:] else 0)))
         sys.exit(0)
     if argv[0] == '--integrity':

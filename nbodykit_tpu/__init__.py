@@ -139,6 +139,13 @@ _default_options = {
     # analyzing corrupt rows. False skips verification (bulk loads
     # where the caller audits out of band).
     'io_verify_checksums': True,
+    # seconds a data_ref request is reserved for its cache-affine
+    # serve worker before any idle worker may steal it (a steal pays
+    # a cold re-ingest; docs/SERVING.md). 'auto' defers to
+    # $NBKIT_DATA_STEAL_GRACE_S, else the AnalysisServer default
+    # (1.0). Must be a non-negative finite number; 0 steals freely.
+    # Resolved at server construction, validated there.
+    'data_steal_grace_s': 'auto',
 }
 
 
@@ -295,6 +302,14 @@ class set_options(object):
         (:mod:`nbodykit_tpu.io.bigfile`); a mismatch raises
         :class:`~nbodykit_tpu.io.bigfile.ChecksumMismatch` with the
         file, column and both sums.  True by default; False opts out.
+    data_steal_grace_s : float or 'auto'
+        seconds a ``data_ref`` request stays reserved for its
+        cache-affine serve worker before any idle worker may steal it
+        (stealing pays a cold catalog re-ingest; docs/SERVING.md).
+        'auto' (the default) defers to ``$NBKIT_DATA_STEAL_GRACE_S``,
+        else 1.0.  Must be non-negative and finite (0 disables the
+        grace window entirely); validated when an
+        :class:`~nbodykit_tpu.serve.AnalysisServer` is constructed.
     """
 
     def __init__(self, **kwargs):

@@ -642,6 +642,64 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                 lines.append('serve        OK: %s' % desc)
 
     if root is not None:
+        # region posture: the latest committed regiontrace round (the
+        # multi-fleet front door, docs/SERVING.md "Region").  Two hard
+        # failures: a lost request (no structured verdict) and an
+        # unverified result-cache hit served stamped verified — the
+        # verified stamp is a chain-of-custody claim, and a forged one
+        # is worse than no cache at all.  Starvation (an interactive
+        # request dying of old age under a bulk flood) warns: it means
+        # fair share is not holding.
+        from .regress import region_summary
+        reg = region_summary(root)
+        if reg is None:
+            lines.append('region       SKIP: no regiontrace record in '
+                         'any committed bench round')
+        elif 'error' in reg:
+            warn.append('region')
+            lines.append('region       WARN: region summary '
+                         'unavailable (%s)' % reg['error'])
+        else:
+            desc = ('%s req over %s fleet(s); cache hit rate %s '
+                    '(%s hit(s)); spills=%s joins=%s (re-formed '
+                    '%s->%s); throttled=%s; interactive p99 %ss'
+                    % (reg.get('requests', '?'),
+                       reg.get('fleet_count', reg.get('fleets', '?')),
+                       reg.get('hit_rate', '?'),
+                       reg.get('result_hits', '?'),
+                       reg.get('spills', '?'), reg.get('joins', '?'),
+                       reg.get('reformed_from', '?'),
+                       reg.get('reformed_to', '?'),
+                       reg.get('throttled', '?'),
+                       reg.get('interactive_p99_s', '?')))
+            if reg.get('lost'):
+                fail.append('region')
+                lines.append('region       FAIL: %s request(s) lost '
+                             'WITHOUT a structured verdict (%s) — '
+                             'every region submission must end as a '
+                             'result' % (reg['lost'], desc))
+            elif reg.get('unverified_as_verified'):
+                fail.append('region')
+                lines.append('region       FAIL: %s unverified '
+                             'result-cache hit(s) served stamped '
+                             'verified — the stamp must only ever '
+                             'mean shadow-verified (%s)'
+                             % (reg['unverified_as_verified'], desc))
+            elif reg.get('cache_bit_identical') is False:
+                fail.append('region')
+                lines.append('region       FAIL: cached result NOT '
+                             'bit-identical to a fresh recomputation '
+                             '(%s)' % desc)
+            elif reg.get('starved'):
+                warn.append('region')
+                lines.append('region       WARN: %s interactive '
+                             'request(s) starved under the bulk '
+                             'flood — fair share is not holding (%s)'
+                             % (reg['starved'], desc))
+            else:
+                lines.append('region       OK: %s' % desc)
+
+    if root is not None:
         # ingestion posture: the latest committed ingest round.  The
         # WARN condition is cache thrash — more evictions than hits
         # means the catalog cache is churning instead of serving, so

@@ -57,6 +57,9 @@ _PHASE_PREFIXES = (
     ('ckpt.', 'resilience'),
     # per-request serving spans (nbodykit_tpu.serve)
     ('serve.', 'serve'),
+    # multi-fleet front-door spans (nbodykit_tpu.serve.region):
+    # routing decisions, result-cache traffic, elastic joins
+    ('region.', 'region'),
     # streaming catalog ingestion (nbodykit_tpu.ingest): the H2D
     # chunk pipeline's transfer time is a first-class phase — the
     # paint it overlaps still bills to 'paint' (above)

@@ -538,7 +538,14 @@ class FleetCheckpointStore(object):
                     'rendezvous digest' % r
         return None
 
-    def _write_manifest(self, key, seq, nranks, decomp):
+    #: Manifest keys a seal may stamp via ``extra`` — hash-covered,
+    #: present only when set, so old manifests keep verifying.  The
+    #: reformed pair records an elastic re-formation boundary (shrink
+    #: OR grow): this seq's shards were repartitioned from a fleet of
+    #: ``reformed_from`` ranks into ``reformed_to``.
+    _EXTRA_KEYS = ('reformed_from', 'reformed_to')
+
+    def _write_manifest(self, key, seq, nranks, decomp, extra=None):
         shards = {}
         for r in range(int(nranks)):
             skey = self.shard_key(key, seq, r)
@@ -557,6 +564,9 @@ class FleetCheckpointStore(object):
         quarantined = suspect_tracker().quarantined()
         if quarantined:
             payload['quarantined'] = quarantined
+        for k in self._EXTRA_KEYS:
+            if extra is not None and k in extra:
+                payload[k] = int(extra[k])
         body = _canonical(payload)
         man = dict(payload, v=1, sealed_at=round(time.time(), 6),
                    sha256=_sha(body))
@@ -573,7 +583,7 @@ class FleetCheckpointStore(object):
         return path
 
     def seal(self, key, seq, nranks=None, mesh=None, rank=None,
-             decomp=None):
+             decomp=None, extra=None):
         """Seal sequence ``seq``: rendezvous (allgather of shard
         digests over ``mesh``), verify every rank landed, rank 0
         writes the manifest, then a fleet barrier so no rank runs
@@ -591,13 +601,15 @@ class FleetCheckpointStore(object):
                         for r in range(nranks)]
                 err = self._verify_rows(key, seq, nranks, rows)
                 if err is None and rank == 0:
-                    self._write_manifest(key, seq, nranks, decomp)
+                    self._write_manifest(key, seq, nranks, decomp,
+                                         extra=extra)
             else:
                 row = self._digest_row(key, seq, rank, nranks)
                 rows = fleet_allgather(mesh, row)
                 err = self._verify_rows(key, seq, nranks, rows)
                 if err is None and rank == 0:
-                    self._write_manifest(key, seq, nranks, decomp)
+                    self._write_manifest(key, seq, nranks, decomp,
+                                         extra=extra)
                 fleet_barrier(mesh, 'fleet.seal')
         if err is not None:
             counter('resilience.fleet.seal_failed').add(1)
@@ -662,6 +674,9 @@ class FleetCheckpointStore(object):
                    'shards': man.get('shards')}
         if 'quarantined' in man:
             payload['quarantined'] = man['quarantined']
+        for k in self._EXTRA_KEYS:
+            if k in man:
+                payload[k] = man[k]
         body = _canonical(payload)
         if _sha(body) != man.get('sha256'):
             counter('resilience.checkpoint.corrupt').add(1)

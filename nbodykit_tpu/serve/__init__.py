@@ -26,7 +26,14 @@ admission-controlled, multi-tenant analysis server.
   Supervisor + option scope (one tenant's fault never touches the
   fleet), graceful drain/shutdown.
 - :mod:`.synth` — deterministic Zipf-popularity request traces for
-  the bench/regress pipeline (``bench.py --serve-trace``).
+  the bench/regress pipeline (``bench.py --serve-trace``,
+  ``--region-trace``).
+- :mod:`.region` — the layer ABOVE the fleet: a :class:`Region`
+  fronts N independent servers with catalog-affine routing +
+  least-loaded spill, content-addressed result memoization
+  (:class:`ResultCache`), per-tenant QoS fair share
+  (:class:`QoSPolicy`), and elastic membership grow sealed with
+  ``reformed_from/to`` stamps (docs/SERVING.md "Region").
 
 Quick start::
 
@@ -46,4 +53,8 @@ from .scheduler import ProgramCache, program_label  # noqa: F401
 from .batching import BatchPolicy  # noqa: F401
 from .server import (COMPLETED, EVICTED, FAILED,  # noqa: F401
                      REJECTED, AnalysisServer, RequestResult)
-from .synth import generate_trace, replay  # noqa: F401
+from .synth import (generate_region_trace, generate_trace,  # noqa: F401
+                    replay, replay_region)
+from .region import (DEFAULT_CLASSES, Fleet, QoSPolicy,  # noqa: F401
+                     Region, RegionRouter, ResultCache,
+                     ServiceClass, result_key)

@@ -1,0 +1,30 @@
+"""The region: one front door over N analysis fleets.
+
+One :class:`~nbodykit_tpu.serve.server.AnalysisServer` is one fleet —
+one queue, one box.  A :class:`Region` is the layer above: it routes
+requests to the catalog-affine fleet (spilling from hot ones with a
+structured verdict), memoizes completed spectra under their content
+address so repeat surveys cost zero FLOPs, grows membership when new
+hosts arrive (the inverse of shrink-to-survive, sealed with
+``reformed_from/to`` stamps), and holds tenants to fair-share token
+buckets so a bulk sweep cannot starve interactive queries.
+
+docs/SERVING.md "Region" is the contract; ``bench.py
+--region-trace`` and the smoke region gate are the proof.
+"""
+
+from .elastic import grow, seal_join  # noqa: F401
+from .qos import (DEFAULT_CLASSES, QoSPolicy,  # noqa: F401
+                  ServiceClass)
+from .result_cache import (JIT_OPTIONS, RUNTIME_OPTIONS,  # noqa: F401
+                           ResultCache, catalog_identity, result_key)
+from .router import (Fleet, Region, RegionRouter,  # noqa: F401
+                     RegionTicket)
+
+__all__ = [
+    'Region', 'Fleet', 'RegionRouter', 'RegionTicket',
+    'ResultCache', 'result_key', 'catalog_identity',
+    'JIT_OPTIONS', 'RUNTIME_OPTIONS',
+    'QoSPolicy', 'ServiceClass', 'DEFAULT_CLASSES',
+    'grow', 'seal_join',
+]

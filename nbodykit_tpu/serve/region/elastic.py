@@ -1,0 +1,120 @@
+"""Elastic grow: absorb newly arrived hosts at a seal boundary.
+
+PR 11 taught the fleet to *shrink* to survive — a re-formed fleet of
+fewer hosts adopts the last sealed checkpoint, repartitioned.  This
+module is the inverse: when new hosts arrive, the region grows a
+membership at the same seal boundary with the same machinery —
+:func:`~nbodykit_tpu.resilience.fleet.repartition` re-slices the
+sealed shard arrays over the *larger* rank count (``np.array_split``
+along axis 0 handles growth exactly as it handles shrinkage), every
+new rank commits its shard, and the new seal's manifest is stamped
+``reformed_from`` / ``reformed_to`` so the history records the join
+the way it already records a shrink.
+
+Two entry points:
+
+- :func:`grow` — the generic one: take a key's latest sealed
+  checkpoint at N ranks and re-seal it at M > N (or M < N — the math
+  is symmetric; the *name* reflects the intended direction).
+- :func:`seal_join` — the region front door's membership seal: one
+  shard per member fleet, state carrying the fleet roster and sticky
+  catalog homes, used by :meth:`~.router.Region.join`.
+
+Both run on the region controller (one process writes all shards, so
+``seal`` verifies against the shared filesystem alone with
+``mesh=None`` — no collective, hence no NBK103 join-barrier surface).
+"""
+
+from ...diagnostics import counter, current_tracer
+from ...resilience.fleet import repartition
+
+
+def _load_shards(store, key, man):
+    """Every rank's ``(state, arrays)`` for a sealed manifest, or None
+    when any shard is torn (the seal verified them once, but disks
+    rot; a grow must never replicate bytes it cannot re-verify)."""
+    per_rank = []
+    for r in range(int(man['nranks'])):
+        got = store.store.load(store.shard_key(key, int(man['seq']),
+                                               r))
+        if got is None:
+            return None
+        per_rank.append(got)
+    return per_rank
+
+
+def grow(store, key, new_nranks, state=None, decomp=None):
+    """Re-seal ``key``'s latest sealed checkpoint at ``new_nranks``.
+
+    Loads the newest verifying manifest (say N ranks), repartitions
+    its shard arrays to ``new_nranks`` via the same
+    ``np.array_split`` re-slice ``FleetCheckpointStore.load`` uses,
+    commits one shard per new rank at the next seq, and seals with
+    the manifest stamped ``reformed_from=N, reformed_to=new_nranks``.
+
+    ``state`` overrides the carried-forward rank-0 user state (None
+    keeps it).  Returns the grow record ``{'seq', 'reformed_from',
+    'reformed_to'}``.  Raises RuntimeError when there is no sealed
+    history or a shard is torn — growing from nothing is a *first
+    seal*, not a re-formation, and the caller should say so.
+    """
+    new_nranks = int(new_nranks)
+    man = store.latest_manifest(key)
+    if man is None:
+        raise RuntimeError('grow(%r): no sealed checkpoint to grow '
+                           'from — seal one first' % key)
+    per_rank = _load_shards(store, key, man)
+    if per_rank is None:
+        raise RuntimeError('grow(%r): sealed seq %d has a torn '
+                           'shard; cannot re-form from it'
+                           % (key, int(man['seq'])))
+    old = int(man['nranks'])
+    if state is None:
+        state = (per_rank[0][0] or {}).get('user')
+    parts = repartition([arrays for _, arrays in per_rank],
+                        new_nranks)
+    seq = store.next_seq(key)
+    for r in range(new_nranks):
+        store.save_shard(key, seq, r, new_nranks, state,
+                         arrays=parts[r] or None)
+    store.seal(key, seq, nranks=new_nranks, rank=0, decomp=decomp,
+               extra={'reformed_from': old,
+                      'reformed_to': new_nranks})
+    counter('region.elastic.reformed').add(1)
+    tr = current_tracer()
+    if tr is not None:
+        tr.event('region.elastic.grow',
+                 {'key': str(key), 'seq': int(seq),
+                  'from': old, 'to': new_nranks})
+    return {'seq': int(seq), 'reformed_from': old,
+            'reformed_to': new_nranks}
+
+
+def seal_join(store, key, state, new_nranks, reformed_from):
+    """Seal region membership at a join boundary.
+
+    One shard per member fleet (rank = member index), user ``state``
+    carrying the roster (fleet names + sticky catalog homes), the
+    manifest stamped ``reformed_from``/``reformed_to``.  Prior sealed
+    membership arrays — when any exist and all verify — are
+    repartitioned forward over the new count; a torn prior shard is
+    simply not carried (membership state is re-derivable from the
+    live region, unlike a checkpointed field)."""
+    new_nranks = int(new_nranks)
+    man = store.latest_manifest(key)
+    parts = None
+    if man is not None:
+        per_rank = _load_shards(store, key, man)
+        if per_rank is not None:
+            arrays = [a for _, a in per_rank]
+            if any(arrays):
+                parts = repartition(arrays, new_nranks)
+    seq = store.next_seq(key)
+    for r in range(new_nranks):
+        store.save_shard(key, seq, r, new_nranks, state,
+                         arrays=(parts[r] or None) if parts else None)
+    store.seal(key, seq, nranks=new_nranks, rank=0,
+               extra={'reformed_from': int(reformed_from),
+                      'reformed_to': new_nranks})
+    return {'seq': int(seq), 'reformed_from': int(reformed_from),
+            'reformed_to': new_nranks}

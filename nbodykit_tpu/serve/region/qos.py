@@ -1,0 +1,158 @@
+"""QoS fair-share: named service classes + per-tenant token buckets.
+
+The fleet queue already ranks by priority/deadline — but priority is
+*self-declared*: a bulk SBI sweep that stamps ``priority=2`` on ten
+thousand requests jumps every interactive P(k) query in the queue, and
+no per-request rank function can tell an urgent tenant from a lying
+one.  Fair share has to key on WHO is asking, not on what they claim:
+each tenant draws from a token bucket whose refill rate comes from the
+tenant's *service class* (assigned by the operator, not the request),
+so a flood from one tenant throttles that tenant and nobody else —
+starvation becomes a provable failure mode instead of a production
+surprise (``tests/test_region.py`` runs the same flood with and
+without the policy; docs/SERVING.md "Region").
+
+Buckets are reservation-style (tokens may go negative): each request
+over the burst gets a monotonically growing due-time, computed purely
+from arithmetic on the refill rate — deterministic, testable without
+wall-clock races.  A class with ``rate=None`` is unthrottled (the
+interactive default): its requests never wait and its deadline
+evictions count as *starvation* in the region scorecard.
+
+Chaos grammar: every reservation passes the ``region.qos.admit``
+fault point, so an injected ``internal`` error proves the region
+converts a broken QoS gate into a structured ``qos_unavailable``
+rejection — never a lost request.
+"""
+
+import threading
+
+from ...diagnostics import counter
+from ...resilience.faults import fault_point
+
+
+class ServiceClass(object):
+    """One named QoS tier.
+
+    ``rate`` is the sustained per-tenant admission rate in requests/s
+    (None = unthrottled); ``burst`` is the bucket depth — how many
+    requests a tenant may land instantly before the rate binds
+    (defaults to ``rate``).
+    """
+
+    __slots__ = ('name', 'rate', 'burst')
+
+    def __init__(self, name, rate=None, burst=None):
+        self.name = str(name)
+        if rate is not None:
+            rate = float(rate)
+            if rate <= 0:
+                raise ValueError('ServiceClass rate must be positive '
+                                 'or None (got %r)' % rate)
+        self.rate = rate
+        self.burst = float(burst) if burst is not None \
+            else (rate if rate is not None else None)
+
+    def __repr__(self):
+        return 'ServiceClass(%r, rate=%r, burst=%r)' % (
+            self.name, self.rate, self.burst)
+
+
+#: The default tiers: interactive flows untouched, batch sustains a
+#: steady clip, bulk is the firehose that must never drown the others.
+DEFAULT_CLASSES = (
+    ServiceClass('interactive', rate=None),
+    ServiceClass('batch', rate=16.0, burst=32),
+    ServiceClass('bulk', rate=4.0, burst=8),
+)
+
+
+class _Bucket(object):
+    """Reservation token bucket: ``reserve`` returns the seconds the
+    caller must wait before its slot arrives (0.0 = admit now).
+    Tokens go negative past the burst, so the Nth over-burst request
+    waits ``N / rate`` — the leaky-bucket due-time ladder."""
+
+    __slots__ = ('rate', 'burst', 'tokens', 'stamp')
+
+    def __init__(self, rate, burst):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.stamp = None
+
+    def reserve(self, now):
+        if self.stamp is None:
+            self.stamp = now
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        self.tokens -= 1.0
+        if self.tokens >= 0.0:
+            return 0.0
+        return -self.tokens / self.rate
+
+
+class QoSPolicy(object):
+    """Tenant -> service class mapping plus the per-tenant buckets.
+
+    Parameters
+    ----------
+    classes : iterable of :class:`ServiceClass` (default
+        :data:`DEFAULT_CLASSES`)
+    tenants : dict tenant-name -> class-name; unmapped tenants fall to
+        ``default_class``
+    default_class : class name for unknown tenants ('interactive' —
+        an unconfigured tenant must never be silently throttled)
+    """
+
+    def __init__(self, classes=None, tenants=None,
+                 default_class='interactive'):
+        self.classes = {c.name: c for c in (classes or DEFAULT_CLASSES)}
+        if default_class not in self.classes:
+            raise ValueError('default_class %r not among classes %s'
+                             % (default_class, sorted(self.classes)))
+        self.tenants = dict(tenants or {})
+        for t, cname in self.tenants.items():
+            if cname not in self.classes:
+                raise ValueError('tenant %r maps to unknown class %r '
+                                 '(valid: %s)'
+                                 % (t, cname, sorted(self.classes)))
+        self.default_class = default_class
+        self._lock = threading.Lock()
+        self._buckets = {}
+        self.throttled = 0
+
+    def service_class(self, tenant):
+        """The :class:`ServiceClass` governing ``tenant``."""
+        return self.classes[self.tenants.get(str(tenant),
+                                             self.default_class)]
+
+    def reserve(self, tenant, now):
+        """``(class_name, delay_s)`` for one request from ``tenant``
+        at monotonic time ``now``.  ``delay_s == 0`` admits
+        immediately; otherwise the caller holds the request until its
+        due-time (or evicts it with a structured verdict when the
+        wait would blow the deadline).  Chaos point:
+        ``region.qos.admit``."""
+        fault_point('region.qos.admit')
+        cls = self.service_class(tenant)
+        if cls.rate is None:
+            return cls.name, 0.0
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = _Bucket(cls.rate,
+                                                         cls.burst)
+            delay = bucket.reserve(now)
+            if delay > 0.0:
+                self.throttled += 1
+        if delay > 0.0:
+            counter('region.qos.throttled').add(1)
+        return cls.name, delay
+
+    def stats(self):
+        with self._lock:
+            return {'tenants': len(self._buckets),
+                    'throttled': self.throttled,
+                    'classes': sorted(self.classes)}

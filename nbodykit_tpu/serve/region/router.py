@@ -1,0 +1,766 @@
+"""The region front door: N fleets, one ``submit``.
+
+A :class:`Region` stands in front of N independent
+:class:`~nbodykit_tpu.serve.server.AnalysisServer` fleets and gives
+tenants the same four-verb surface one fleet has — ``submit`` /
+``wait`` / ``drain`` / ``shutdown`` — while adding what one fleet
+cannot: placement *across* fleets, memoization of completed results,
+fair share between tenants, and membership that grows at runtime.
+
+The submit path, in order:
+
+1. **Result cache** (:mod:`.result_cache`): the request's content
+   address is computed on the submitting thread and looked up first —
+   a hit is served immediately with zero FLOPs, zero queueing and
+   zero QoS cost (a memoized answer is free; throttling it would be
+   pure spite).
+2. **QoS gate** (:mod:`.qos`): misses pass the tenant's fair-share
+   bucket.  An over-rate tenant's request is *held* to its due-time
+   by the pacer thread (or evicted with a structured
+   ``qos_throttled`` verdict when the due-time would blow its own
+   deadline) — it is never silently dropped and it never crowds the
+   fleet queues.
+3. **Router** (:class:`RegionRouter`): catalog-affine placement keyed
+   on the content address — the PR-13 worker-placement idiom lifted
+   to fleet granularity — spilling to the least-loaded fleet with a
+   structured redirect verdict, health-checked via each fleet's
+   live load/accepting surface so a dead or preempted fleet is
+   routed around, not into.
+4. **Harvest**: the fleet's verdict is re-wrapped with the routing
+   verdict and region-level latency; a COMPLETED seeded-or-data
+   result is committed to the cache (stamped ``verified`` only when
+   the execution was shadow-verified).
+
+Every region submission ends as exactly one
+:class:`~nbodykit_tpu.serve.server.RequestResult`; ``summary()['lost']``
+is the number the doctor FAILs on, exactly as at fleet scope.
+"""
+
+import heapq
+import threading
+import time
+
+from ...diagnostics import counter, gauge, span
+from ...resilience.faults import corrupt_spec
+from ..scheduler import affinity
+from ..server import COMPLETED, EVICTED, REJECTED, RequestResult
+from .result_cache import result_key
+
+
+class Fleet(object):
+    """One named fleet behind the region front door."""
+
+    __slots__ = ('name', 'server')
+
+    def __init__(self, name, server):
+        self.name = str(name)
+        self.server = server
+
+    def load(self):
+        """The router's health/load probe: the fleet's live queue
+        surface (cheap — one lock, no device work)."""
+        return self.server.load()
+
+    def __repr__(self):
+        return 'Fleet(%r, %d workers)' % (self.name,
+                                          len(self.server.meshes))
+
+
+class RegionRouter(object):
+    """Catalog-affine fleet placement with structured verdicts.
+
+    Placement is the scheduler's worker-affinity idiom lifted one
+    level: ``hash(program_key [+ data_ref path]) % n_fleets``, so
+    identical programs land where their executables are warm and
+    repeat surveys land where their catalog is resident.  ``data_ref``
+    paths get *sticky homes* — once a catalog has been ingested
+    somewhere, later requests follow it there even when the hash says
+    otherwise (the resident copy beats a cold re-ingest) — until a
+    membership change re-homes them (:meth:`rehome_locked`).
+
+    Verdict grammar (every route returns one structured dict):
+
+    - ``{'code': 'affinity', 'fleet': F, 'depth': d}`` — the hash
+      said F and F is healthy and shallow enough.
+    - ``{'code': 'catalog_home', 'fleet': F}`` — a sticky data_ref
+      home.
+    - ``{'code': 'spill', 'fleet': G, 'from': F, 'from_depth': d0,
+      'depth': d1}`` — F is over ``spill_depth``; G is the
+      least-loaded healthy fleet.
+    - ``{'code': 'rerouted_dead', 'fleet': G, 'from': F}`` — F is
+      not accepting (dead, preempted, shut down).
+    - ``{'code': 'no_fleet', 'fleets': n}`` — nothing in the region
+      accepts; the region rejects with this reason.
+    """
+
+    def __init__(self, fleets, spill_depth=8):
+        self.lock = threading.Lock()
+        self._fleets = list(fleets)
+        self.spill_depth = int(spill_depth)
+        # path -> {'fleet': name, 'salt': int}: the sticky data_ref
+        # homes; 'salt' re-derives the hash slot at rehome time
+        self._homes = {}
+        self.rehomed = 0
+
+    def fleets(self):
+        with self.lock:
+            return list(self._fleets)
+
+    def get(self, name):
+        with self.lock:
+            for f in self._fleets:
+                if f.name == name:
+                    return f
+        raise KeyError('no fleet named %r in the region' % name)
+
+    def add_locked(self, fleet):
+        """Append a member (caller holds :attr:`lock` — the join seal
+        boundary)."""
+        if any(f.name == fleet.name for f in self._fleets):
+            raise ValueError('fleet name %r already in the region'
+                             % fleet.name)
+        self._fleets.append(fleet)
+
+    def rehome_locked(self):
+        """Re-derive every sticky catalog home over the new member
+        count — the live-CatalogCache analogue of
+        :func:`~nbodykit_tpu.resilience.fleet.repartition`: ownership
+        is reassigned deterministically from the new count at the
+        seal boundary.  A moved catalog pays one cold ingest at its
+        new home while the old copy ages out of that fleet's LRU (the
+        device arrays cannot teleport between fleets).  Returns the
+        number of homes that moved."""
+        n = len(self._fleets)
+        moved = 0
+        for path, home in list(self._homes.items()):
+            name = self._fleets[home['salt'] % n].name
+            if name != home['fleet']:
+                home['fleet'] = name
+                moved += 1
+        self.rehomed += moved
+        if moved:
+            counter('region.elastic.rehomed').add(moved)
+        return moved
+
+    @staticmethod
+    def _accepting(fleet):
+        try:
+            return bool(fleet.load().get('accepting'))
+        except Exception:       # pragma: no cover - dying fleet
+            return False
+
+    @staticmethod
+    def _depth(fleet):
+        try:
+            state = fleet.load()
+            return int(state.get('queued', 0)) \
+                + int(state.get('inflight', 0))
+        except Exception:       # pragma: no cover - dying fleet
+            return 1 << 30
+
+    def route(self, request):
+        """The structured placement verdict for ``request`` (see the
+        class docstring for the grammar).  Pure decision — nothing is
+        submitted here."""
+        with self.lock:
+            fleets = list(self._fleets)
+            homes = self._homes
+            n = len(fleets)
+            healthy = [f for f in fleets if self._accepting(f)]
+            if not healthy:
+                return {'code': 'no_fleet', 'fleets': n,
+                        'detail': 'no accepting fleet in the region'}
+            path = None
+            if getattr(request, 'data_ref', None) is not None:
+                path = request.data_ref.get('path')
+                home = homes.get(path)
+                if home is not None:
+                    for f in healthy:
+                        if f.name == home['fleet']:
+                            return {'code': 'catalog_home',
+                                    'fleet': f.name}
+                    # resident home is dead: fall through to the
+                    # affinity hash and re-home below
+            # the PR-13 placement idiom at fleet granularity: the
+            # ndevices argument is pinned to 1 so the hash keys
+            # content identity, not any one fleet's sub-mesh width
+            aff = fleets[affinity(request, 1, n)]
+            if not self._accepting(aff):
+                target = min(healthy, key=self._depth)
+                verdict = {'code': 'rerouted_dead',
+                           'fleet': target.name, 'from': aff.name,
+                           'detail': 'affinity fleet not accepting'}
+            else:
+                depth = self._depth(aff)
+                target = aff
+                verdict = {'code': 'affinity', 'fleet': aff.name,
+                           'depth': depth}
+                if depth > self.spill_depth:
+                    spill = min(healthy, key=self._depth)
+                    sdepth = self._depth(spill)
+                    if spill is not aff and sdepth < depth:
+                        target = spill
+                        verdict = {'code': 'spill',
+                                   'fleet': spill.name,
+                                   'from': aff.name,
+                                   'from_depth': depth,
+                                   'depth': sdepth,
+                                   'detail': 'affinity fleet over '
+                                             'spill depth %d'
+                                             % self.spill_depth}
+            if path is not None:
+                homes[path] = {'fleet': target.name,
+                               'salt': hash((path,))}
+            return verdict
+
+
+class RegionTicket(object):
+    """One region submission: the request, its tenant/class, the
+    routing verdict, and (once dispatched) the inner fleet ticket."""
+
+    __slots__ = ('request', 'tenant', 'class_name', 'throttleable',
+                 'submitted_at', 'seq', 'verdict', 'digest',
+                 'key_text', 'fleet', 'inner', 'done', 'dispatched',
+                 'result', 'followers')
+
+    def __init__(self, request, tenant, submitted_at, seq):
+        self.request = request
+        self.tenant = str(tenant)
+        self.class_name = None
+        self.throttleable = False
+        self.submitted_at = submitted_at
+        self.seq = seq
+        self.verdict = None
+        self.digest = None
+        self.key_text = None
+        self.fleet = None
+        self.inner = None
+        self.done = threading.Event()
+        self.dispatched = threading.Event()
+        self.result = None
+        # singleflight: identical concurrent requests attach here and
+        # are served from this leader's committed result.  None once
+        # the leader has finished (sealed — late arrivals recompute).
+        self.followers = []
+
+
+class Region(object):
+    """The multi-fleet front door (see the module docstring).
+
+    Parameters
+    ----------
+    fleets : list of :class:`Fleet`, or of ``(name, server)`` pairs
+    result_cache : :class:`.result_cache.ResultCache` or None —
+        content-addressed memoization of completed results
+    qos : :class:`.qos.QoSPolicy` or None — per-tenant fair share
+        (None admits everything immediately: the policy-free region
+        is the starvation-prone one the tests prove against)
+    spill_depth : queue depth at which the affinity fleet spills to
+        the least-loaded one
+    checkpoint : :class:`~nbodykit_tpu.resilience.fleet
+        .FleetCheckpointStore` or None — when given, every
+        :meth:`join` seals a membership manifest stamped
+        ``reformed_from``/``reformed_to`` (docs/SERVING.md "Region")
+    """
+
+    _CKPT_KEY = 'region'
+
+    def __init__(self, fleets, result_cache=None, qos=None,
+                 spill_depth=8, checkpoint=None):
+        members = [f if isinstance(f, Fleet) else Fleet(*f)
+                   for f in fleets]
+        if not members:
+            raise ValueError('a region needs at least one fleet')
+        self.router = RegionRouter(members, spill_depth=spill_depth)
+        self.cache = result_cache
+        self.qos = qos
+        self.store = checkpoint
+        # the canonical sub-mesh width result addresses use: results
+        # are device-count invariant by construction (the suite
+        # asserts bit-identity across widths), so one width keys them
+        # all; computing it here keeps result_key on the submitting
+        # thread, where the tenant's option scope lives
+        self._key_ndevices = members[0].server.ndevices
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._held = []
+        self._tickets = []
+        self.results = {}
+        self._submitted = 0
+        self._seq = 0
+        self._accepting = True
+        self._stop = False
+        self._started_at = time.monotonic()
+        self._routed = {}
+        self._class_lat = {}
+        self._class_counts = {}
+        self._starved = 0
+        self._qos_evicted = 0
+        self._unverified_as_verified = 0
+        self._leaders = {}      # digest -> inflight leader ticket
+        self._joins = []
+        self._pacer = threading.Thread(target=self._pace,
+                                       name='region-pacer',
+                                       daemon=True)
+        self._pacer.start()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def drain(self, timeout=None):
+        """Harvest every accepted ticket's verdict (held tickets wait
+        for their due-time first).  True when fully drained."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                pending = [t for t in self._tickets
+                           if not t.done.is_set()]
+            if not pending:
+                return True
+            left = None if deadline is None \
+                else deadline - time.monotonic()
+            if left is not None and left <= 0:
+                return False
+            self.wait(pending[0], timeout=left)
+            if deadline is not None \
+                    and time.monotonic() >= deadline \
+                    and not pending[0].done.is_set():
+                return False
+
+    def shutdown(self, drain=True, timeout=None, fleets=True):
+        """Stop accepting, optionally drain, stop the pacer, and (by
+        default) shut the member fleets down too.  Anything still
+        held by the pacer gets a structured ``shutdown`` eviction —
+        never silence.  Idempotent."""
+        with self._cv:
+            self._accepting = False
+        if drain:
+            self.drain(timeout=timeout)
+        with self._cv:
+            held = [t for _, _, t in self._held]
+            self._held = []
+            self._stop = True
+            self._cv.notify_all()
+        for t in held:
+            self._finish(t, RequestResult(
+                t.request.request_id, EVICTED,
+                reason={'code': 'shutdown',
+                        'detail': 'region shut down while held by '
+                                  'fair-share pacing'},
+                algorithm=t.request.algorithm,
+                shape_class=t.request.shape_class))
+        self._pacer.join(timeout=5.0)
+        if fleets:
+            for f in self.router.fleets():
+                f.server.shutdown(drain=drain, timeout=timeout)
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, request, tenant='default'):
+        """Admit one request from ``tenant``.  Returns a
+        :class:`RegionTicket`; rejections, throttle evictions and
+        result-cache hits resolve immediately."""
+        now = time.monotonic()
+        counter('region.submitted').add(1)
+        with self._lock:
+            self._submitted += 1
+            self._seq += 1
+            ticket = RegionTicket(request, tenant, now, self._seq)
+            self._tickets.append(ticket)
+            accepting = self._accepting
+        if not accepting:
+            self._finish(ticket, RequestResult(
+                request.request_id, REJECTED,
+                reason={'code': 'shutting_down',
+                        'detail': 'region no longer accepting '
+                                  'requests'},
+                algorithm=request.algorithm,
+                shape_class=request.shape_class))
+            return ticket
+        if self.qos is not None:
+            # label the class up front (no token spent) so cache hits
+            # and followers land in the right by_class row
+            ticket.class_name = self.qos.service_class(tenant).name
+        # 1. the result cache: a memoized answer is free — served
+        # before the QoS gate (throttling zero FLOPs helps nobody)
+        if self.cache is not None:
+            digest, text = result_key(request,
+                                      ndevices=self._key_ndevices)
+            ticket.digest, ticket.key_text = digest, text
+            entry = self.cache.get(digest)
+            if entry is not None:
+                self._serve_hit(ticket, entry, now)
+                return ticket
+            # singleflight: an identical request already inflight is
+            # the leader; attach and be served from its commit (a
+            # closed-loop slam of repeats computes each answer once)
+            with self._lock:
+                leader = self._leaders.get(digest)
+                if leader is not None and leader.followers is not None:
+                    leader.followers.append(ticket)
+                    self._routed['follower'] = \
+                        self._routed.get('follower', 0) + 1
+                    counter('region.result_cache.followers').add(1)
+                    return ticket
+                self._leaders[digest] = ticket
+        # 2. the QoS gate
+        if self.qos is not None:
+            try:
+                cname, delay = self.qos.reserve(tenant, now)
+            except Exception as e:
+                # a broken gate (chaos: region.qos.admit) rejects
+                # with a structured verdict — never loses the request
+                counter('region.qos.failed').add(1)
+                self._finish(ticket, RequestResult(
+                    request.request_id, REJECTED,
+                    reason={'code': 'qos_unavailable',
+                            'error': str(e)[:200],
+                            'type': type(e).__name__},
+                    latency_s=time.monotonic() - now,
+                    algorithm=request.algorithm,
+                    shape_class=request.shape_class))
+                return ticket
+            ticket.class_name = cname
+            ticket.throttleable = \
+                self.qos.service_class(tenant).rate is not None
+            if delay > 0.0:
+                if delay >= request.deadline_s:
+                    self._qos_evict(ticket, delay, now)
+                    return ticket
+                with self._cv:
+                    if self._stop:
+                        pass        # raced shutdown; fall through
+                    else:
+                        heapq.heappush(self._held,
+                                       (now + delay, ticket.seq,
+                                        ticket))
+                        gauge('region.qos.held').set(len(self._held))
+                        self._cv.notify_all()
+                        return ticket
+        # 3. route + submit
+        self._dispatch(ticket)
+        return ticket
+
+    def _qos_evict(self, ticket, delay, now):
+        with self._lock:
+            self._qos_evicted += 1
+        self._finish(ticket, RequestResult(
+            ticket.request.request_id, EVICTED,
+            reason={'code': 'qos_throttled',
+                    'would_wait_s': round(delay, 3),
+                    'deadline_s': ticket.request.deadline_s,
+                    'detail': 'fair-share due-time past the '
+                              'request deadline'},
+            latency_s=time.monotonic() - now,
+            algorithm=ticket.request.algorithm,
+            shape_class=ticket.request.shape_class))
+
+    def _serve_hit(self, ticket, entry, now):
+        """Deliver a result-cache hit: zero FLOPs, the honest
+        ``verified`` stamp, and the hash-checked bytes.  The
+        ``region.result.stamp`` corrupt rule flips the stamp here so
+        CI proves the doctor catches an unverified hit served as
+        verified."""
+        verified = bool(entry['verified'])
+        stamped = verified
+        if corrupt_spec('region.result.stamp'):
+            stamped = True
+        if stamped and not verified:
+            with self._lock:
+                self._unverified_as_verified += 1
+            counter('region.result_cache.unverified_stamp').add(1)
+        ticket.verdict = {'code': 'result_cache',
+                          'digest': ticket.digest,
+                          'verified': stamped}
+        with self._lock:
+            self._routed['result_cache'] = \
+                self._routed.get('result_cache', 0) + 1
+        self._finish(ticket, RequestResult(
+            ticket.request.request_id, COMPLETED,
+            x=entry['x'], y=entry['y'], nmodes=entry['nmodes'],
+            latency_s=time.monotonic() - now,
+            events=[{'kind': 'result_cache',
+                     'digest': ticket.digest, 'verified': stamped}],
+            algorithm=ticket.request.algorithm,
+            shape_class=ticket.request.shape_class))
+
+    def _dispatch(self, ticket):
+        """Route and hand ``ticket`` to its fleet (submit thread or
+        pacer thread)."""
+        now = time.monotonic()
+        if now >= ticket.submitted_at + ticket.request.deadline_s:
+            self._finish(ticket, RequestResult(
+                ticket.request.request_id, EVICTED,
+                reason={'code': 'deadline',
+                        'waited_s': round(now - ticket.submitted_at,
+                                          3),
+                        'detail': 'deadline passed while held by '
+                                  'fair-share pacing'},
+                latency_s=now - ticket.submitted_at,
+                algorithm=ticket.request.algorithm,
+                shape_class=ticket.request.shape_class))
+            return
+        with span('region.route',
+                  request_id=ticket.request.request_id,
+                  tenant=ticket.tenant):
+            verdict = self.router.route(ticket.request)
+        ticket.verdict = verdict
+        with self._lock:
+            self._routed[verdict['code']] = \
+                self._routed.get(verdict['code'], 0) + 1
+        counter('region.route.%s' % verdict['code']).add(1)
+        if verdict['code'] == 'no_fleet':
+            self._finish(ticket, RequestResult(
+                ticket.request.request_id, REJECTED,
+                reason=dict(verdict),
+                latency_s=time.monotonic() - ticket.submitted_at,
+                algorithm=ticket.request.algorithm,
+                shape_class=ticket.request.shape_class))
+            return
+        fleet = self.router.get(verdict['fleet'])
+        ticket.fleet = fleet
+        ticket.inner = fleet.server.submit(ticket.request)
+        ticket.dispatched.set()
+
+    # -- the pacer --------------------------------------------------------
+
+    def _pace(self):
+        """Drain the fair-share hold queue: dispatch each held ticket
+        at its due-time (deadline-checked at dispatch)."""
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+                if not self._held:
+                    self._cv.wait(timeout=0.2)
+                    continue
+                due, _, ticket = self._held[0]
+                now = time.monotonic()
+                if due > now:
+                    self._cv.wait(timeout=min(due - now, 0.2))
+                    continue
+                heapq.heappop(self._held)
+                gauge('region.qos.held').set(len(self._held))
+            self._dispatch(ticket)
+
+    # -- harvest ----------------------------------------------------------
+
+    def wait(self, ticket, timeout=None):
+        """Block for a ticket's terminal region
+        :class:`RequestResult` (harvesting — and memoizing — the
+        fleet verdict when the ticket was dispatched)."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while not ticket.done.is_set():
+            left = None if deadline is None \
+                else deadline - time.monotonic()
+            if left is not None and left <= 0:
+                return ticket.result
+            if ticket.inner is not None:
+                res = ticket.fleet.server.wait(ticket.inner,
+                                               timeout=left)
+                if res is not None:
+                    self._deliver(ticket, res)
+                break
+            ticket.dispatched.wait(timeout=left if left is not None
+                                   else 0.2)
+        return ticket.result
+
+    def _deliver(self, ticket, res):
+        """Re-wrap a fleet verdict as the region verdict: region
+        latency (hold time included), the routing verdict as an
+        event, and the memoization commit for completed results."""
+        with self._lock:
+            if ticket.done.is_set():
+                return
+        if res.status == COMPLETED and ticket.digest is not None \
+                and self.cache is not None:
+            # verified == this exact execution was shadow-compared
+            # on a second sub-mesh and delivered (a mismatch would
+            # have retried or failed before reaching here)
+            self.cache.put(ticket.digest, ticket.key_text,
+                           res.x, res.y, res.nmodes,
+                           verified=bool(getattr(ticket.inner,
+                                                 'verify', False)))
+        events = list(res.events)
+        events.append(dict(ticket.verdict or {}, kind='route'))
+        self._finish(ticket, RequestResult(
+            res.request_id, res.status, x=res.x, y=res.y,
+            nmodes=res.nmodes, reason=res.reason,
+            latency_s=time.monotonic() - ticket.submitted_at,
+            events=events, options=res.options,
+            admit_options=res.admit_options,
+            batch_size=res.batch_size, algorithm=res.algorithm,
+            shape_class=res.shape_class))
+
+    def _finish(self, ticket, result):
+        cls = ticket.class_name or 'unclassified'
+        with self._lock:
+            if ticket.done.is_set():    # pragma: no cover - idem
+                return
+            # seal the singleflight: late identical arrivals after
+            # this point become their own leaders (and, when this run
+            # completed, immediate cache hits)
+            followers, ticket.followers = ticket.followers, None
+            if ticket.digest is not None \
+                    and self._leaders.get(ticket.digest) is ticket:
+                del self._leaders[ticket.digest]
+            self.results[result.request_id] = result
+            counts = self._class_counts.setdefault(
+                cls, {'completed': 0, 'rejected': 0, 'evicted': 0,
+                      'failed': 0})
+            counts[result.status] = counts.get(result.status, 0) + 1
+            if result.status == COMPLETED \
+                    and result.latency_s is not None:
+                self._class_lat.setdefault(cls, []).append(
+                    result.latency_s)
+            if result.status == EVICTED \
+                    and (result.reason or {}).get('code') \
+                    == 'deadline' and not ticket.throttleable:
+                # an unthrottled-class (or policy-free) request dying
+                # of old age in a queue IS starvation — the failure
+                # mode the QoS layer exists to prevent
+                self._starved += 1
+                counter('region.qos.starved').add(1)
+            ticket.result = result
+        counter('region.%s' % result.status).add(1)
+        ticket.done.set()
+        ticket.dispatched.set()
+        with self._cv:
+            self._cv.notify_all()
+        for f in (followers or ()):
+            entry = None
+            if result.status == COMPLETED and self.cache is not None \
+                    and f.digest is not None:
+                # a real cache read: hash-verified bytes, honest hit
+                # accounting — the follower IS the repeat customer
+                entry = self.cache.get(f.digest)
+            if entry is not None:
+                self._serve_hit(f, entry, f.submitted_at)
+            else:
+                # the leader did not commit a servable result (failed,
+                # evicted, rejected, or the entry was torn): the
+                # follower recomputes through the normal path
+                self._dispatch(f)
+
+    # -- elastic grow -----------------------------------------------------
+
+    def join(self, server, name=None):
+        """Absorb a newly arrived fleet at a seal boundary (the
+        inverse of shrink-to-survive): routing pauses, the member
+        list grows, sticky catalog homes repartition over the new
+        count, and — when the region has a checkpoint store — the
+        membership manifest is sealed stamped
+        ``reformed_from``/``reformed_to``.  Returns the join info."""
+        with self.router.lock:
+            old = len(self.router._fleets)
+            fleet = server if isinstance(server, Fleet) \
+                else Fleet(name or 'fleet-%d' % old, server)
+            self.router.add_locked(fleet)
+            new = old + 1
+            rehomed = self.router.rehome_locked()
+            names = [f.name for f in self.router._fleets]
+            homes = {p: h['fleet']
+                     for p, h in self.router._homes.items()}
+        counter('region.elastic.joins').add(1)
+        from ...diagnostics import current_tracer
+        tr = current_tracer()
+        if tr is not None:
+            tr.event('region.elastic.join',
+                     {'from': old, 'to': new, 'fleet': fleet.name})
+        info = {'fleet': fleet.name, 'reformed_from': old,
+                'reformed_to': new, 'rehomed': rehomed}
+        if self.store is not None:
+            from .elastic import seal_join
+            sealed = seal_join(self.store, self._CKPT_KEY,
+                               {'fleets': names, 'homes': homes},
+                               new_nranks=new, reformed_from=old)
+            info['manifest_seq'] = sealed['seq']
+        with self._lock:
+            self._joins.append(info)
+        return info
+
+    # -- reporting --------------------------------------------------------
+
+    @staticmethod
+    def _pctile(values, q):
+        if not values:
+            return None
+        vs = sorted(values)
+        idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+        return vs[idx]
+
+    def summary(self):
+        """The region scorecard: the fleet-level ledger lifted one
+        level, plus routing verdict counts, the result-cache posture
+        (hit rate, corrupt entries, the ``unverified_as_verified``
+        count the doctor FAILs on), the QoS fair-share ledger
+        (throttled / starved / per-class latency), and the elastic
+        join history."""
+        with self._lock:
+            results = list(self.results.values())
+            submitted = self._submitted
+            held = len(self._held)
+            pending = sum(1 for t in self._tickets
+                          if not t.done.is_set())
+            routed = dict(self._routed)
+            class_lat = {k: list(v)
+                         for k, v in self._class_lat.items()}
+            class_counts = {k: dict(v)
+                            for k, v in self._class_counts.items()}
+            starved = self._starved
+            qos_evicted = self._qos_evicted
+            unverified = self._unverified_as_verified
+            joins = list(self._joins)
+        by_status = {}
+        for r in results:
+            by_status[r.status] = by_status.get(r.status, 0) + 1
+        cache = self.cache.stats() if self.cache is not None else None
+        if cache is not None:
+            looked = cache['hits'] + cache['misses']
+            cache['hit_rate'] = round(cache['hits'] / looked, 4) \
+                if looked else None
+            cache['unverified_as_verified'] = unverified
+        by_class = {}
+        for cls in sorted(set(class_lat) | set(class_counts)):
+            lat = class_lat.get(cls, [])
+            by_class[cls] = dict(
+                class_counts.get(cls, {}),
+                n=sum(class_counts.get(cls, {}).values()),
+                p50_s=self._pctile(lat, 0.50),
+                p99_s=self._pctile(lat, 0.99))
+        fleets = {f.name: f.server.summary()
+                  for f in self.router.fleets()}
+        wall = max(time.monotonic() - self._started_at, 1e-9)
+        completed = by_status.get(COMPLETED, 0)
+        return {
+            'submitted': submitted,
+            'resolved': len(results),
+            'lost': submitted - len(results) - pending,
+            'completed': completed,
+            'rejected': by_status.get(REJECTED, 0),
+            'evicted': by_status.get(EVICTED, 0),
+            'failed': by_status.get('failed', 0),
+            'held': held,
+            'rps': completed / wall,
+            'wall_s': wall,
+            'fleet_count': len(fleets),
+            'routed': routed,
+            'result_cache': cache,
+            'qos': {'enabled': self.qos is not None,
+                    'throttled': self.qos.throttled
+                    if self.qos is not None else 0,
+                    'qos_evicted': qos_evicted,
+                    'starved': starved},
+            'by_class': by_class,
+            'elastic': {'joins': len(joins),
+                        'rehomed': self.router.rehomed,
+                        'events': joins},
+            'fleets': fleets,
+        }

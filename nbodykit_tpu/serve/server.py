@@ -56,6 +56,30 @@ EVICTED = 'evicted'
 FAILED = 'failed'
 
 
+def _resolve_data_steal_grace(value):
+    """The effective data-steal grace window in seconds: the
+    ``data_steal_grace_s`` option when set, else
+    ``$NBKIT_DATA_STEAL_GRACE_S``, else the class default (1.0).
+    Must parse as a non-negative finite float (0 = steal freely)."""
+    import math
+    import os
+    source = 'set_options(data_steal_grace_s=...)'
+    if value in (None, 'auto'):
+        value = os.environ.get('NBKIT_DATA_STEAL_GRACE_S')
+        source = '$NBKIT_DATA_STEAL_GRACE_S'
+        if value is None:
+            return AnalysisServer.DATA_STEAL_GRACE_S
+    try:
+        grace = float(value)
+    except (TypeError, ValueError):
+        grace = -1.0
+    if not math.isfinite(grace) or grace < 0:
+        raise ValueError(
+            'data_steal_grace_s must be a non-negative finite '
+            'number of seconds, got %r (via %s)' % (value, source))
+    return grace
+
+
 class RequestResult(object):
     """The one terminal verdict every submitted request gets."""
 
@@ -195,6 +219,8 @@ class AnalysisServer(object):
         _cb = int(_cb) if isinstance(_cb, (int, float)) \
             and not isinstance(_cb, bool) else None
         self.catalogs = [CatalogCache(_cb) for _ in self.meshes]
+        self.data_steal_grace_s = _resolve_data_steal_grace(
+            _global_options['data_steal_grace_s'])
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -413,18 +439,21 @@ class AnalysisServer(object):
     # re-ingest onto a cold CatalogCache, so locality is worth a short
     # wait — but only a short one: a wedged affinity worker must not
     # strand the request (deadline eviction is not a placement policy).
+    # The instance value resolves set_options(data_steal_grace_s=...)
+    # / $NBKIT_DATA_STEAL_GRACE_S at construction; this class attr is
+    # the documented default.
     DATA_STEAL_GRACE_S = 1.0
 
     def _pick_locked(self, wi, now):
         """Best ticket for worker ``wi``: its own affinity first, else
         steal the globally best-ranked one.  data_ref tickets resist
-        stealing for ``DATA_STEAL_GRACE_S`` — their catalog may be
+        stealing for ``data_steal_grace_s`` — their catalog may be
         resident in the affinity worker's cache."""
         mine = [t for t in self._pending if t.affinity == wi]
         pool = mine or [t for t in self._pending
                         if t.request.data_ref is None
                         or now - t.submitted_at
-                        >= self.DATA_STEAL_GRACE_S]
+                        >= self.data_steal_grace_s]
         if not pool:
             return None
         best = min(pool, key=rank)
@@ -698,6 +727,18 @@ class AnalysisServer(object):
         vs = sorted(values)
         idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
         return vs[idx]
+
+    def load(self):
+        """The live load/health surface a region router probes before
+        every placement: queue depth, inflight work, and whether this
+        fleet still accepts — one lock, no device work, cheap enough
+        to call per-route (``summary()`` is the full scorecard; this
+        is the heartbeat)."""
+        with self._lock:
+            return {'queued': len(self._pending),
+                    'inflight': self._inflight,
+                    'accepting': self._accepting and not self._stop,
+                    'workers': len(self.meshes)}
 
     def summary(self):
         """The serving scorecard: totals by terminal status, real

@@ -89,3 +89,94 @@ def replay(server, trace, interarrival_s=0.0, seed=0):
     for t in tickets:
         t.done.wait()
     return tickets
+
+
+#: Default region tenants: (name, weight, declared_priority).  The
+#: bulk tenant self-declares priority 2 on every request — the lie the
+#: QoS fair-share layer exists to defeat (priority is what a request
+#: CLAIMS; the service class is what the operator ASSIGNED).
+_TENANTS = (
+    ('interactive-a', 0.35, None),
+    ('interactive-b', 0.25, None),
+    ('bulk-sweep', 0.40, 2),
+)
+
+
+def generate_region_trace(n, seed=0, deadline_s=120.0, tenants=None,
+                          repeat_fraction=0.25, join_at=None):
+    """A deterministic multi-fleet trace: ``n`` items, each either
+    ``{'tenant', 'request'}`` or the scripted host-arrival event
+    ``{'event': 'join'}``.
+
+    Per-tenant Zipf popularity: each tenant draws from the shape
+    catalog *rotated by its index*, so tenants have different hot
+    shapes — the regime where catalog-affine fleet routing pays.
+    ``repeat_fraction`` of a tenant's requests re-issue an exact
+    earlier (algorithm, nmesh, npart, seed) from that tenant's own
+    history — the repeat-survey slice that exercises result-cache
+    hits.  ``join_at`` (a 0..1 fraction) inserts the join event at
+    that point in the trace for the elastic-grow path.
+
+    ``tenants`` is an iterable of ``(name, weight,
+    declared_priority)`` (default :data:`_TENANTS`, whose bulk tenant
+    stamps ``priority=2`` on everything — deliberately abusive).
+    """
+    rng = random.Random(seed)
+    tenants = list(tenants) if tenants is not None else list(_TENANTS)
+    names = [t[0] for t in tenants]
+    weights = [float(t[1]) for t in tenants]
+    declared = {t[0]: t[2] for t in tenants}
+    zipf = [1.0 / (rank + 1) for rank in range(len(_CATALOG))]
+    history = {name: [] for name in names}
+    out = []
+    join_idx = None if join_at is None \
+        else max(0, min(int(n), int(float(join_at) * int(n))))
+    for i in range(int(n)):
+        if i == join_idx:
+            out.append({'event': 'join'})
+        tenant = rng.choices(names, weights=weights)[0]
+        past = history[tenant]
+        if past and rng.random() < repeat_fraction:
+            algo, nmesh, npart, rseed = rng.choice(past)
+        else:
+            ti = names.index(tenant)
+            rotated = _CATALOG[ti % len(_CATALOG):] \
+                + _CATALOG[:ti % len(_CATALOG)]
+            algo, nmesh, npart = rng.choices(rotated,
+                                             weights=zipf)[0]
+            rseed = rng.randrange(2 ** 20)
+            past.append((algo, nmesh, npart, rseed))
+        prio = declared[tenant]
+        if prio is None:
+            prio = rng.choice((0, 0, 1, 1, 2))
+        out.append({'tenant': tenant, 'request': AnalysisRequest(
+            algorithm=algo, nmesh=nmesh, npart=npart, dtype='f4',
+            seed=rseed, deadline_s=deadline_s, priority=prio,
+            request_id='region-%05d' % i)})
+    if join_idx is not None and join_idx >= int(n):
+        out.append({'event': 'join'})
+    return out
+
+
+def replay_region(region, items, interarrival_s=0.0, seed=0,
+                  on_join=None):
+    """Replay a region trace: submit each ``{'tenant', 'request'}``
+    item under its tenant; at a ``{'event': 'join'}`` item call
+    ``on_join(region)`` (the caller supplies the arriving fleet —
+    ignored when None).  Waits for every verdict; returns the ticket
+    list in submission order."""
+    import time
+    rng = random.Random(seed)
+    tickets = []
+    for item in items:
+        if 'event' in item:
+            if item['event'] == 'join' and on_join is not None:
+                on_join(region)
+            continue
+        tickets.append(region.submit(item['request'],
+                                     tenant=item['tenant']))
+        if interarrival_s > 0:
+            time.sleep(rng.expovariate(1.0 / interarrival_s))
+    for t in tickets:
+        region.wait(t)
+    return tickets

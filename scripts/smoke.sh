@@ -349,6 +349,37 @@ print('shadow gate OK: 1 request shadow-verified bit-identical '
       'across sub-meshes, 0 mismatches')
 EOF
 
+# region gate (docs/SERVING.md "Region"): a two-fleet router trace
+# with a third fleet joining mid-trace — the bench asserts the whole
+# region posture in one shot: >=1 content-addressed result-cache hit
+# (repeat slices of the trace), >=1 structured spill redirect (the
+# closed-loop slam overflows spill_depth), the elastic join sealed
+# with reformed_from/to stamps, fair share holding under the bulk
+# tenant's priority-2 flood (throttled > 0, starved == 0), cached
+# bytes bit-identical to a fresh recomputation, and zero lost
+echo "== region gate (40 req, 2 fleets + mid-trace join) =="
+env JAX_NUM_CPU_DEVICES=2 \
+    python bench.py --region-trace 40 2 1 0 > "$SMOKE_TMP/region.json"
+python - "$SMOKE_TMP" <<'EOF'
+import json, os, sys
+rec = json.loads(open(os.path.join(
+    sys.argv[1], 'region.json')).read().strip().splitlines()[-1])
+assert rec['lost'] == 0, rec
+assert rec['result_hits'] >= 1, rec
+assert rec['spills'] >= 1, rec
+assert rec['joins'] == 1, rec
+assert rec['reformed_from'] == 2 and rec['reformed_to'] == 3, rec
+assert rec['throttled'] > 0, rec
+assert rec['starved'] == 0, rec
+assert rec['unverified_as_verified'] == 0, rec
+assert rec['cache_bit_identical'] is True, rec
+assert 'error' not in rec, rec
+print('region gate OK: %(completed)d/%(submitted)d completed over '
+      '%(fleet_count)d fleets, hits=%(result_hits)d '
+      'spills=%(spills)d joins=%(joins)d throttled=%(throttled)d '
+      'starved=%(starved)d lost=%(lost)d' % rec)
+EOF
+
 # the rule-tree-produced PartitionSpecs cross shard_map boundaries in
 # the paint path; the sharding-flow analyses must stay clean over the
 # whole surface with nothing new and nothing grandfathered (the
@@ -416,6 +447,7 @@ python -m pytest \
     tests/test_fleet.py \
     tests/test_tune.py \
     tests/test_serve.py \
+    tests/test_region.py \
     tests/test_lint.py \
     tests/test_lint_dataflow.py \
     tests/test_lint_shardflow.py \
