@@ -1057,6 +1057,93 @@ def run_fft_decomp(Nmesh=256, reps=3):
     return _stamp(rec)
 
 
+#: The serving-posture exemplar fraction the trace benches run (and
+#: measure overhead) under: request-level envelope spans for every
+#: request (waterfalls stay complete), full kernel-span detail for a
+#: sampled few.  Full-exemplar (the default, 1.0) is the debug
+#: posture — its kernel spans sync eagerly inside `block_until_ready`
+#: and cost 10-20% wall at serve request rates on a busy host.
+SERVE_TRACE_EXEMPLAR = 0.02
+
+
+def _flush_only_sync():
+    """Scope the serving-posture tracing env: trace records are
+    flushed (they survive a SIGKILL of the *process*) but not fsynced
+    per span, and kernel spans are exemplar-sampled at
+    :data:`SERVE_TRACE_EXEMPLAR` — the posture a latency-sensitive
+    deployment would run, and the one the <5% overhead gate holds."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        keys = {'NBKIT_DIAGNOSTICS_SYNC': '0',
+                'NBKIT_TRACE_EXEMPLAR': str(SERVE_TRACE_EXEMPLAR)}
+        prev = {k: os.environ.get(k) for k in keys}
+        os.environ.update(keys)
+        try:
+            yield
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    return _scope()
+
+
+def _measure_overhead(once, n, reps=6):
+    """Tracing overhead, measured honestly: warm every program cache
+    with one throwaway run, then run ``reps`` mirrored off/on pairs in
+    ABBA order (off,on,on,off,...) — host walls on a shared box drift
+    monotonically over minutes, and the mirrored ordering cancels that
+    drift to first order where a fixed off-then-on order would charge
+    it all to one side.  Mean-of-sides over the mirrored sequence is
+    the estimator; run-to-run wall noise on a busy 1-core host is
+    ±10%, so anything under 4 mirrored pairs is a coin flip against
+    the 5% gate."""
+    import tempfile
+    once(None)                  # warm every program cache first
+    walls_off, walls_on = [], []
+    for rep in range(int(reps)):
+        legs = [False, True] if rep % 2 == 0 else [True, False]
+        for traced in legs:
+            if traced:
+                walls_on.append(
+                    once(tempfile.mkdtemp(prefix='nbkit-ovh-')))
+            else:
+                walls_off.append(once(None))
+    wall_off = sum(walls_off) / len(walls_off)
+    wall_on = sum(walls_on) / len(walls_on)
+    return {'n': n, 'reps': int(reps), 'sync': 0,
+            'exemplar': SERVE_TRACE_EXEMPLAR,
+            'walls_on_s': [round(w, 3) for w in walls_on],
+            'walls_off_s': [round(w, 3) for w in walls_off],
+            'wall_on_s': round(wall_on, 3),
+            'wall_off_s': round(wall_off, 3),
+            'overhead': round((wall_on - wall_off)
+                              / max(wall_off, 1e-9), 4)}
+
+
+def _waterfall_stamp(tracedir):
+    """Reduce a trace directory to the waterfall-completeness ledger
+    the round record stamps (and the doctor's slo posture judges)."""
+    try:
+        from nbodykit_tpu.diagnostics import request_report
+        from nbodykit_tpu.diagnostics.analyze import load_processes
+        procs, _ = load_processes(tracedir)
+        rep = request_report(procs)
+        return {'traces': rep['traces'],
+                'complete': rep['complete'],
+                'complete_fraction': rep['complete_fraction'],
+                'orphan_spans': rep['orphan_spans'],
+                'incomplete': rep['incomplete'][:8],
+                'critical_stages': rep['critical_stages'],
+                'stage_totals_s': {k: round(v, 3) for k, v in
+                                   rep['stage_totals_s'].items()}}
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+
+
 def run_serve_trace(n=1000, per_task=1, max_batch=8, seed=0):
     """The multi-tenant serving round: replay a deterministic
     ``n``-request synthetic trace (nbodykit_tpu.serve.synth — Zipf
@@ -1070,6 +1157,7 @@ def run_serve_trace(n=1000, per_task=1, max_batch=8, seed=0):
     degrade / resume, ``lost`` stays 0).  ``value`` is p99 seconds —
     lower is better, which is what regress.py trends."""
     jax = _setup_jax()
+    import tempfile
     import nbodykit_tpu
     from nbodykit_tpu.resilience.faults import fault_counts, \
         reset_faults
@@ -1085,12 +1173,15 @@ def run_serve_trace(n=1000, per_task=1, max_batch=8, seed=0):
            "faults_spec": os.environ.get('NBKIT_FAULTS', '')}
     reset_faults()
     trace = generate_trace(n, seed=seed, deadline_s=600.0)
+    tracedir = tempfile.mkdtemp(prefix='nbkit-strace-')
     t0 = time.time()
-    with AnalysisServer(per_task=per_task, max_queue=max(n, 16),
-                        batch=BatchPolicy(max_batch=max_batch,
-                                          max_delay_s=0.05)) as srv:
-        replay(srv, trace, seed=seed)
-        summary = srv.summary()
+    with _flush_only_sync(), \
+            nbodykit_tpu.set_options(diagnostics=tracedir):
+        with AnalysisServer(per_task=per_task, max_queue=max(n, 16),
+                            batch=BatchPolicy(max_batch=max_batch,
+                                              max_delay_s=0.05)) as srv:
+            replay(srv, trace, seed=seed)
+            summary = srv.summary()
     rec['wall_s'] = round(time.time() - t0, 3)
     for key in ('submitted', 'completed', 'rejected', 'evicted',
                 'failed', 'lost', 'retried', 'fault_degraded',
@@ -1102,18 +1193,57 @@ def run_serve_trace(n=1000, per_task=1, max_batch=8, seed=0):
         rec[key] = round(summary[key], 5) \
             if summary[key] is not None else None
     rec['table'] = summary['by_class']
+    # the queue-wait vs service-time split (the combined p50/p99
+    # above stay for history continuity)
+    for key in ('queue_p50_s', 'queue_p99_s', 'service_p50_s',
+                'service_p99_s'):
+        rec[key] = round(summary[key], 5) \
+            if summary.get(key) is not None else None
+    rec['slo'] = summary['slo']
+    rec['waterfalls'] = _waterfall_stamp(tracedir)
     rec['faults_injected'] = {k: v for k, v in fault_counts().items()
                              if k.startswith('serve.')}
     rec['tuned'] = tuned_snapshot(nmesh=64, npart=50000, dtype='f4',
                                   nproc=per_task)
+
+    # tracing overhead: the same closed-loop slam, fresh servers,
+    # compile caches warm, with and without a live tracer
+    n_ov = min(128, n)
+    ov_trace = generate_trace(n_ov, seed=seed + 1, deadline_s=600.0)
+
+    def _once(diag):
+        reset_faults()
+        with nbodykit_tpu.set_options(diagnostics=diag):
+            w0 = time.time()
+            with AnalysisServer(per_task=per_task,
+                                max_queue=max(n_ov, 16),
+                                batch=BatchPolicy(
+                                    max_batch=max_batch,
+                                    max_delay_s=0.05)) as s2:
+                replay(s2, ov_trace, seed=seed + 1)
+            return time.time() - w0
+
+    with _flush_only_sync():
+        rec['trace_overhead'] = _measure_overhead(_once, n_ov)
+    errs = []
     if summary['lost']:
-        rec['error'] = ('%d request(s) lost without a structured '
-                        'verdict' % summary['lost'])
+        errs.append('%d request(s) lost without a structured '
+                    'verdict' % summary['lost'])
+    if rec['trace_overhead']['overhead'] >= 0.05:
+        errs.append('tracing overhead %.1f%% over the 5%% budget'
+                    % (100.0 * rec['trace_overhead']['overhead']))
+    wf = rec['waterfalls']
+    if wf.get('traces') and wf.get('complete') != wf.get('traces'):
+        errs.append('%d request waterfall(s) incomplete'
+                    % (wf['traces'] - wf['complete']))
+    if errs:
+        rec['error'] = '; '.join(errs)
     rec['value'] = rec['p99_s'] if rec['p99_s'] is not None else -1.0
     return _stamp(rec)
 
 
-def run_region_trace(n=200, fleets=2, per_task=1, seed=0):
+def run_region_trace(n=200, fleets=2, per_task=1, seed=0,
+                     interarrival_s=0.0):
     """The multi-fleet region round: replay a deterministic
     ``n``-item multi-tenant trace (per-tenant Zipf shapes, a
     repeat-request slice, a scripted mid-trace host arrival) through
@@ -1139,6 +1269,7 @@ def run_region_trace(n=200, fleets=2, per_task=1, seed=0):
     jax = _setup_jax()
     import tempfile
     import numpy as np
+    import nbodykit_tpu
     from nbodykit_tpu.parallel.runtime import cpu_mesh, use_mesh
     from nbodykit_tpu.resilience.faults import reset_faults
     from nbodykit_tpu.resilience.fleet import FleetCheckpointStore
@@ -1152,6 +1283,7 @@ def run_region_trace(n=200, fleets=2, per_task=1, seed=0):
     rec = {"metric": "regiontrace_n%d" % n, "unit": "s",
            "platform": platform, "requests": n, "fleets": fleets,
            "per_task": per_task, "seed": seed,
+           "interarrival_s": float(interarrival_s),
            "faults_spec": os.environ.get('NBKIT_FAULTS', '')}
     reset_faults()
 
@@ -1173,11 +1305,6 @@ def run_region_trace(n=200, fleets=2, per_task=1, seed=0):
                  ServiceClass('bulk', rate=16.0, burst=8)],
         tenants={'bulk-sweep': 'bulk'},
         default_class='interactive')
-    region = Region([('fleet-%d' % i, _fleet())
-                     for i in range(int(fleets))],
-                    result_cache=ResultCache(
-                        os.path.join(tmp, 'results')),
-                    qos=qos, spill_depth=2, checkpoint=store)
     trace = generate_region_trace(n, seed=seed, deadline_s=600.0,
                                   join_at=0.5)
     joins = []
@@ -1185,34 +1312,48 @@ def run_region_trace(n=200, fleets=2, per_task=1, seed=0):
     def _arrive(reg):
         joins.append(reg.join(_fleet()))
 
-    t0 = time.time()
-    replay_region(region, trace, seed=seed, on_join=_arrive)
-    region.drain(timeout=600)
-    # bit-identity: one cached spectrum vs a fresh recomputation on
-    # a virgin single-fleet server (same request, zero shared state)
-    probe = next((item['request'] for item in trace
-                  if 'request' in item
-                  and region.results.get(
-                      item['request'].request_id) is not None
-                  and region.results[
-                      item['request'].request_id].ok), None)
-    identical = None
-    if probe is not None:
-        from nbodykit_tpu.serve import AnalysisRequest
-        cached = region.results[probe.request_id]
-        srv = _fleet()
-        fresh = srv.wait(srv.submit(AnalysisRequest.from_dict(
-            dict(probe.to_dict(), request_id='region-bitcheck'))),
-            timeout=300)
-        srv.shutdown()
-        identical = bool(
-            fresh is not None and fresh.ok
-            and np.array_equal(np.asarray(cached.y),
-                               np.asarray(fresh.y))
-            and np.array_equal(np.asarray(cached.nmodes),
-                               np.asarray(fresh.nmodes)))
-    summary = region.summary()
-    region.shutdown()
+    tracedir = tempfile.mkdtemp(prefix='nbkit-rtrace-')
+    with _flush_only_sync(), \
+            nbodykit_tpu.set_options(diagnostics=tracedir):
+        region = Region([('fleet-%d' % i, _fleet())
+                         for i in range(int(fleets))],
+                        result_cache=ResultCache(
+                            os.path.join(tmp, 'results')),
+                        qos=qos, spill_depth=2, checkpoint=store)
+        t0 = time.time()
+        # interarrival_s > 0 paces arrivals open-loop (Poisson) — the
+        # load shape a latency SLO is judged under; 0 is the
+        # closed-loop slam (right for routing/QoS mechanics, but it
+        # charges pure queueing backlog to every latency number)
+        replay_region(region, trace, seed=seed, on_join=_arrive,
+                      interarrival_s=float(interarrival_s))
+        region.drain(timeout=600)
+        # bit-identity: one cached spectrum vs a fresh recomputation
+        # on a virgin single-fleet server (same request, zero shared
+        # state)
+        probe = next((item['request'] for item in trace
+                      if 'request' in item
+                      and region.results.get(
+                          item['request'].request_id) is not None
+                      and region.results[
+                          item['request'].request_id].ok), None)
+        identical = None
+        if probe is not None:
+            from nbodykit_tpu.serve import AnalysisRequest
+            cached = region.results[probe.request_id]
+            srv = _fleet()
+            fresh = srv.wait(srv.submit(AnalysisRequest.from_dict(
+                dict(probe.to_dict(), request_id='region-bitcheck'))),
+                timeout=300)
+            srv.shutdown()
+            identical = bool(
+                fresh is not None and fresh.ok
+                and np.array_equal(np.asarray(cached.y),
+                                   np.asarray(fresh.y))
+                and np.array_equal(np.asarray(cached.nmodes),
+                                   np.asarray(fresh.nmodes)))
+        summary = region.summary()
+        region.shutdown()
     rec['wall_s'] = round(time.time() - t0, 3)
     for key in ('submitted', 'resolved', 'completed', 'rejected',
                 'evicted', 'lost', 'fleet_count'):
@@ -1238,10 +1379,48 @@ def run_region_trace(n=200, fleets=2, per_task=1, seed=0):
     inter = summary['by_class'].get('interactive', {})
     rec['interactive_p50_s'] = inter.get('p50_s')
     rec['interactive_p99_s'] = inter.get('p99_s')
+    rec['slo'] = summary['slo']
+    rec['waterfalls'] = _waterfall_stamp(tracedir)
+
+    # tracing overhead: a fresh single-join-free region, compile
+    # caches warm, the same mixed-tenant slam with and without a
+    # live tracer
+    n_ov = min(128, n)
+    ov_trace = generate_region_trace(n_ov, seed=seed + 1,
+                                     deadline_s=600.0)
+
+    def _ov_once(diag):
+        reset_faults()
+        with nbodykit_tpu.set_options(diagnostics=diag):
+            # no QoS here on purpose: the pacer's token-bucket beats
+            # couple the wall to scheduler jitter, which would swamp
+            # the overhead signal this side-run exists to isolate
+            reg = Region(
+                [('ov-fleet-%d' % i, _fleet())
+                 for i in range(int(fleets))],
+                result_cache=ResultCache(tempfile.mkdtemp(
+                    prefix='nbkit-ovh-cache-')),
+                qos=None, spill_depth=2)
+            w0 = time.time()
+            replay_region(reg, ov_trace, seed=seed + 1)
+            reg.drain(timeout=600)
+            wall = time.time() - w0
+            reg.shutdown()
+            return wall
+
+    with _flush_only_sync():
+        rec['trace_overhead'] = _measure_overhead(_ov_once, n_ov)
     errs = []
     if summary['lost']:
         errs.append('%d request(s) lost without a structured verdict'
                     % summary['lost'])
+    if rec['trace_overhead']['overhead'] >= 0.05:
+        errs.append('tracing overhead %.1f%% over the 5%% budget'
+                    % (100.0 * rec['trace_overhead']['overhead']))
+    wf = rec['waterfalls']
+    if wf.get('traces') and wf.get('complete') != wf.get('traces'):
+        errs.append('%d request waterfall(s) incomplete'
+                    % (wf['traces'] - wf['complete']))
     if rec['unverified_as_verified']:
         errs.append('%d unverified cache hit(s) served as verified'
                     % rec['unverified_as_verified'])
@@ -2135,7 +2314,8 @@ if __name__ == '__main__':
             int(argv[1]) if argv[1:] else 200,
             fleets=int(argv[2]) if argv[2:] else 2,
             per_task=int(argv[3]) if argv[3:] else 1,
-            seed=int(argv[4]) if argv[4:] else 0)))
+            seed=int(argv[4]) if argv[4:] else 0,
+            interarrival_s=float(argv[5]) if argv[5:] else 0.0)))
         sys.exit(0)
     if argv[0] == '--integrity':
         print(json.dumps(run_integrity(

@@ -146,6 +146,15 @@ _default_options = {
     # (1.0). Must be a non-negative finite number; 0 steals freely.
     # Resolved at server construction, validated there.
     'data_steal_grace_s': 'auto',
+    # live telemetry export (nbodykit_tpu.diagnostics.export,
+    # docs/OBSERVABILITY.md): an integer TCP port starts a
+    # zero-dependency background HTTP thread serving the metrics
+    # registry and SLO state as Prometheus text (/metrics), JSON
+    # snapshots (/metrics.json, /slo) and the flight-recorder ring
+    # (/flight). 0 binds an ephemeral port (the exporter reports the
+    # real one); None disables. Seeded from $NBKIT_TELEMETRY_PORT so
+    # detached workers can be scraped without code changes.
+    'telemetry_port': os.environ.get('NBKIT_TELEMETRY_PORT') or None,
 }
 
 
@@ -310,6 +319,15 @@ class set_options(object):
         else 1.0.  Must be non-negative and finite (0 disables the
         grace window entirely); validated when an
         :class:`~nbodykit_tpu.serve.AnalysisServer` is constructed.
+    telemetry_port : int or None
+        TCP port for the live telemetry exporter
+        (:mod:`nbodykit_tpu.diagnostics.export`): a background HTTP
+        thread serving the metrics registry as Prometheus text
+        (``/metrics``), JSON snapshots (``/metrics.json``, ``/slo``)
+        and the flight-recorder ring (``/flight``).  0 binds an
+        ephemeral port; None (the default) disables.  Seeded from
+        ``$NBKIT_TELEMETRY_PORT``.  The serve/region front doors
+        start the exporter on construction when this is set.
     """
 
     def __init__(self, **kwargs):

@@ -34,9 +34,11 @@ Enable with ``nbodykit_tpu.set_options(diagnostics='/tmp/trace')`` (or
 import functools
 import os
 
-from .trace import (NULL_SPAN, Tracer, atomic_write, current_tracer,  # noqa: F401
-                    export_chrome_trace, read_trace, trace_files,
-                    trace_state_clean)
+from .trace import (NULL_SPAN, RequestContext, Tracer,  # noqa: F401
+                    atomic_write, current_tracer, exemplar_fraction,
+                    export_chrome_trace, new_request_context,
+                    read_trace, trace_context, trace_files,
+                    trace_scope, trace_state_clean)
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, counter, gauge, histogram,
                       device_watermarks, install_compile_telemetry,
@@ -45,8 +47,13 @@ from .report import render_text, summarize, write_report  # noqa: F401
 # the function is re-exported as analyze_trace so the submodule
 # remains reachable as nbodykit_tpu.diagnostics.analyze
 from .analyze import analyze as analyze_trace  # noqa: F401
-from .analyze import render_analysis  # noqa: F401
+from .analyze import render_analysis, request_report  # noqa: F401
 from .regress import build_history, render_regress  # noqa: F401
+from .slo import (DEFAULT_SLOS, SLObjective, SLOPolicy,  # noqa: F401
+                  SLOTracker)
+from .export import (FLIGHT, FlightRecorder, TelemetryExporter,  # noqa: F401
+                     ensure_exporter, flight_recorder,
+                     prometheus_text, register_source)
 
 
 def enabled():

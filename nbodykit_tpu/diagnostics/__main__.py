@@ -789,12 +789,79 @@ def run_doctor(trace=None, root='.', self_check_only=False,
             else:
                 lines.append('integrity    OK: %s' % desc)
 
+    if root is not None:
+        # SLO posture: the latest bench round carrying an slo stamp
+        # (diagnostics/slo.py).  Fast-window burn over threshold means
+        # the error budget dies in days — FAIL (a page); slow-window
+        # burn over 1.0 is budget-on-track-to-exhaust — WARN (a
+        # ticket).  An orphaned or incomplete request waterfall fails
+        # too: a trace that cannot be followed end-to-end is the
+        # observability analogue of a lost request.  Tracing overhead
+        # at or over 5% fails — telemetry must never become the
+        # workload.
+        from .regress import slo_summary
+        slo = slo_summary(root)
+        if slo is None:
+            lines.append('slo          SKIP: no slo-stamped record in '
+                         'any committed bench round')
+        elif 'error' in slo:
+            warn.append('slo')
+            lines.append('slo          WARN: slo summary unavailable '
+                         '(%s)' % slo['error'])
+        else:
+            burns = '; '.join(
+                '%s %s (burn fast %s / slow %s)'
+                % (c, d.get('verdict', '?'), d.get('fast_burn', '?'),
+                   d.get('slow_burn', '?'))
+                for c, d in sorted((slo.get('classes') or {}).items()))
+            ov = slo.get('overhead')
+            desc = ('%s/%s waterfall(s) complete, %s orphan span(s); '
+                    '%s%s'
+                    % (slo.get('complete', '?'), slo.get('traces', '?'),
+                       slo.get('orphan_spans', '?'), burns or '-',
+                       '; tracing overhead %.1f%%' % (100.0 * ov)
+                       if ov is not None else ''))
+            incomplete = (slo.get('traces') or 0) \
+                - (slo.get('complete') or 0)
+            if slo.get('verdict') == 'FAIL':
+                fail.append('slo')
+                lines.append('slo          FAIL: fast-window burn '
+                             'rate over threshold — the error budget '
+                             'is being consumed at page speed (%s)'
+                             % desc)
+            elif ov is not None and ov >= 0.05:
+                fail.append('slo')
+                lines.append('slo          FAIL: tracing overhead '
+                             '%.1f%% is at or over the 5%% budget '
+                             '(%s)' % (100.0 * ov, desc))
+            elif incomplete or slo.get('orphan_spans'):
+                fail.append('slo')
+                lines.append('slo          FAIL: %s request '
+                             'waterfall(s) incomplete / %s orphan '
+                             'span(s) — every request must render a '
+                             'fully linked waterfall (%s)'
+                             % (incomplete,
+                                slo.get('orphan_spans', '?'), desc))
+            elif slo.get('verdict') == 'WARN':
+                warn.append('slo')
+                lines.append('slo          WARN: slow-window burn '
+                             'rate over 1.0 — the error budget is on '
+                             'track to exhaust (%s)' % desc)
+            else:
+                lines.append('slo          OK: %s' % desc)
+
     verdict = 'FAIL (%s)' % ', '.join(fail) if fail else \
         ('WARN (%s)' % ', '.join(warn) if warn else 'OK')
     out.write('== nbodykit-tpu doctor ==\n')
     for line in lines:
         out.write(line + '\n')
     out.write('VERDICT: %s\n' % verdict)
+    if fail:
+        # seal the flight recorder beside the trace: a FAIL verdict is
+        # a post-mortem moment and the last N request summaries are
+        # exactly what it wants
+        from .export import FLIGHT
+        FLIGHT.dump('doctor.fail')
     return 1 if fail else 0
 
 

@@ -386,6 +386,194 @@ def merge_timeline(procs, offsets, max_depth=0):
     return rows
 
 
+# ---------------------------------------------------------------------------
+# request-scoped waterfalls (trace-id linked spans; trace.py RequestContext)
+
+# span-name (prefix-matched in order) -> waterfall stage
+_STAGE_PREFIXES = (
+    ('serve.queue.wait', 'queue'),
+    ('region.qos.hold', 'qos_hold'),
+    ('region.route', 'route'),
+    ('region.cache.commit', 'cache_commit'),
+    ('serve.shadow_verify', 'verify'),
+    ('serve.submit', 'admission'),
+    ('region.submit', 'admission'),
+    ('serve.request', 'service'),
+    ('compile.', 'compile'),
+    ('fft.a2a.', 'a2a'),
+    ('fft.', 'fft'),
+    ('exchange', 'fft'),
+    ('paint', 'paint'),
+    ('readout', 'paint'),
+    ('resilience.backoff', 'resilience'),
+    ('ingest', 'ingest'),
+)
+
+#: root span names a request's context re-parents onto
+_ROOT_NAMES = ('region.submit', 'serve.submit')
+#: terminal (delivery) event names — a waterfall without one is a
+#: request the stack lost track of
+_TERMINAL_NAMES = ('region.deliver', 'serve.deliver')
+#: zero-duration link spans tying a trace to its leader's trace
+_LINK_NAMES = ('serve.batch.member', 'region.singleflight.follower',
+               'region.cache.hit')
+
+
+def stage_of(name):
+    """The waterfall stage a span name belongs to, or None."""
+    for prefix, stage in _STAGE_PREFIXES:
+        if name.startswith(prefix):
+            return stage
+    if 'binning' in name:
+        return 'binning'
+    return None
+
+
+def collect_traces(procs):
+    """trace-id -> record list (spans AND begin events, every pid).
+    Only records stamped with a ``trace`` field participate."""
+    traces = {}
+    for p, records in procs.items():
+        for r in records:
+            if r.get('t') not in ('span', 'b'):
+                continue
+            tid = r.get('trace')
+            if tid:
+                traces.setdefault(tid, []).append(r)
+    return traces
+
+
+def _request_parent(s, by_pid_id):
+    """A span's causal parent: same-thread nesting (``par``) wins,
+    falling back to the cross-thread remote parent (``rpar``)."""
+    par = s.get('par') or 0
+    if par and (s.get('pid'), par) in by_pid_id:
+        return by_pid_id[(s.get('pid'), par)]
+    rpar = s.get('rpar') or 0
+    if rpar:
+        # rpar carries only the originating process's span id; the
+        # serve stack is one process per fleet today, so a plain
+        # id-match is exact (first root wins if pids ever collide)
+        for (pid, sid), ps in by_pid_id.items():
+            if sid == rpar:
+                return ps
+    return None
+
+
+def _stage_totals(spans, by_pid_id):
+    """Per-stage busy seconds with nested double counting removed —
+    the per-request analogue of :func:`_phase_totals`, resolving
+    parents across thread hops via ``rpar``."""
+    contrib = {}
+    for s in spans:
+        if stage_of(s.get('name', '')) is not None:
+            contrib[(s.get('pid'), s.get('id'))] = \
+                float(s.get('dur', 0.0))
+    for s in spans:
+        key = (s.get('pid'), s.get('id'))
+        if key not in contrib:
+            continue
+        ps = _request_parent(s, by_pid_id)
+        while ps is not None:
+            pkey = (ps.get('pid'), ps.get('id'))
+            if pkey in contrib and pkey != key:
+                contrib[pkey] -= float(s.get('dur', 0.0))
+                break
+            ps = _request_parent(ps, by_pid_id)
+    totals = {}
+    for s in spans:
+        key = (s.get('pid'), s.get('id'))
+        if key in contrib:
+            st = stage_of(s.get('name', ''))
+            totals[st] = totals.get(st, 0.0) + max(contrib[key], 0.0)
+    return {st: round(v, 6) for st, v in sorted(totals.items())}
+
+
+def waterfall(trace_id, records):
+    """One request's linked waterfall from its stamped records.
+
+    Returns a dict with the stage breakdown (nested spans counted
+    once, cross-thread links resolved), the end-to-end ``wall_s``
+    (root begin to last record end), orphan spans (a ``par``/``rpar``
+    that resolves to nothing in this trace — a thread hop the code
+    forgot to propagate across), the critical stage, and
+    ``complete``: root present, terminal delivery present, zero
+    orphans.
+    """
+    spans = [r for r in records if r.get('t') == 'span']
+    all_ids = {r.get('id') for r in records}
+    by_pid_id = {}
+    for s in spans:
+        by_pid_id.setdefault((s.get('pid'), s.get('id')), s)
+    orphans = []
+    for s in spans:
+        par = s.get('par') or 0
+        rpar = s.get('rpar') or 0
+        ref = par or rpar
+        if ref and ref not in all_ids:
+            orphans.append({'name': s.get('name'), 'id': s.get('id'),
+                            'pid': s.get('pid'), 'ref': ref})
+    roots = [s for s in spans if s.get('name') in _ROOT_NAMES
+             and not (s.get('par') or s.get('rpar'))]
+    terminals = [s for s in spans if s.get('name') in _TERMINAL_NAMES]
+    links = [s for s in spans if s.get('name') in _LINK_NAMES]
+    t0 = min((float(s.get('ts', 0.0)) for s in spans), default=None)
+    t1 = max((float(s.get('ts', 0.0)) + float(s.get('dur', 0.0))
+              for s in spans), default=None)
+    stages = _stage_totals(spans, by_pid_id)
+    request_id = status = None
+    for s in roots + terminals:
+        attrs = s.get('attrs') or {}
+        request_id = request_id or attrs.get('request_id')
+        status = attrs.get('status') or status
+    leader = None
+    for s in links:
+        leader = (s.get('attrs') or {}).get('leader_trace') or leader
+    critical = max(stages, key=stages.get) if stages else None
+    return {'trace': trace_id, 'request_id': request_id,
+            'status': status,
+            'wall_s': round(t1 - t0, 6) if spans else None,
+            'nspans': len(spans), 'stages': stages,
+            'critical': critical,
+            'orphans': orphans, 'leader_trace': leader,
+            'complete': bool(roots) and bool(terminals)
+            and not orphans}
+
+
+def request_report(procs, max_examples=8):
+    """Every request waterfall in the trace, aggregated.
+
+    ``waterfalls`` holds up to ``max_examples`` exemplars (the worst
+    wall clocks); the counts cover everything: ``traces``,
+    ``complete``, ``orphan_spans``, ``incomplete`` trace ids (bounded),
+    and ``stage_totals_s`` summed across every request — the fleet-wide
+    answer to "where does request time go".
+    """
+    traces = collect_traces(procs)
+    wfs = [waterfall(tid, recs) for tid, recs in sorted(traces.items())]
+    complete = sum(1 for w in wfs if w['complete'])
+    orphan_spans = sum(len(w['orphans']) for w in wfs)
+    incomplete = [w['trace'] for w in wfs if not w['complete']]
+    stage_totals = {}
+    crit = {}
+    for w in wfs:
+        for st, v in w['stages'].items():
+            stage_totals[st] = stage_totals.get(st, 0.0) + v
+        if w['critical']:
+            crit[w['critical']] = crit.get(w['critical'], 0) + 1
+    exemplars = sorted((w for w in wfs if w['wall_s'] is not None),
+                       key=lambda w: -w['wall_s'])[:max_examples]
+    return {'traces': len(wfs), 'complete': complete,
+            'complete_fraction': round(complete / len(wfs), 6)
+            if wfs else None,
+            'orphan_spans': orphan_spans,
+            'incomplete': incomplete[:32],
+            'critical_stages': dict(sorted(crit.items())),
+            'stage_totals_s': {st: round(v, 6) for st, v
+                               in sorted(stage_totals.items())},
+            'waterfalls': exemplars}
+
+
 def analyze(path, anchors=None):
     """Full fleet analysis of a trace file/directory; returns a plain
     JSON-serializable dict (see module docstring for the pieces)."""
@@ -413,6 +601,7 @@ def analyze(path, anchors=None):
         'critical_path': critical_path(procs, offsets),
         'hangs': find_hangs(procs),
         'heartbeat': heartbeat_report(procs, offsets),
+        'requests': request_report(procs),
     }
 
 
@@ -483,6 +672,32 @@ def render_analysis(res, max_timeline=40):
         if 'compile' in cp.get('phases', {}):
             w('  (compile spans are recorded out-of-band and overlap '
               'the phase they interrupted; phases may sum past 100%)')
+
+    req = res.get('requests', {})
+    if req.get('traces'):
+        w('-- request waterfalls (%d traced; %d complete, %d orphan '
+          'spans) --' % (req['traces'], req.get('complete', 0),
+                         req.get('orphan_spans', 0)))
+        if req.get('incomplete'):
+            w('  INCOMPLETE traces: %s%s'
+              % (','.join(req['incomplete'][:6]),
+                 ' ...' if len(req['incomplete']) > 6 else ''))
+        tot = req.get('stage_totals_s', {})
+        if tot:
+            s = sum(tot.values()) or 1.0
+            w('  stage totals across all requests:')
+            for st, v in sorted(tot.items(), key=lambda kv: -kv[1]):
+                w('    %-12s  %10.4f s  %5.1f%%'
+                  % (st, v, 100.0 * v / s))
+        for wf in req.get('waterfalls', [])[:4]:
+            stages = '  '.join('%s=%s' % (st, _fmt_ms(v))
+                               for st, v in sorted(
+                                   wf['stages'].items(),
+                                   key=lambda kv: -kv[1]))
+            w('  %s %-22s %10s  critical=%s  %s'
+              % (wf['trace'], wf.get('request_id') or '?',
+                 _fmt_ms(wf['wall_s']) if wf.get('wall_s') else '?',
+                 wf.get('critical'), stages))
 
     hangs = res.get('hangs', {})
     if hangs.get('hung_collectives'):

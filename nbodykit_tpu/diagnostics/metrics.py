@@ -164,10 +164,45 @@ class MetricsRegistry(object):
 
 REGISTRY = MetricsRegistry()
 
-# module-level conveniences bound to the process-wide registry
-counter = REGISTRY.counter
-gauge = REGISTRY.gauge
-histogram = REGISTRY.histogram
+
+def labelled(name, labels):
+    """Fold ``labels`` into a registry name: ``'a.b{k=v,k2=v2}'``
+    (keys sorted, so the same label set always lands on the same
+    metric).  The registry stays a flat name->metric map — labels are
+    a naming convention the export plane (export.py) parses back into
+    Prometheus label syntax."""
+    if not labels:
+        return name
+    body = ','.join('%s=%s' % (k, labels[k]) for k in sorted(labels))
+    return '%s{%s}' % (name, body)
+
+
+def split_label(name):
+    """Inverse of :func:`labelled`: ``(bare_name, {labels})``."""
+    if name.endswith('}') and '{' in name:
+        bare, _, body = name.partition('{')
+        labels = {}
+        for part in body[:-1].split(','):
+            k, eq, v = part.partition('=')
+            if eq:
+                labels[k] = v
+        return bare, labels
+    return name, {}
+
+
+# module-level conveniences bound to the process-wide registry; the
+# keyword form labels the metric: ``gauge('serve.queue_depth',
+# fleet='a')`` names ``serve.queue_depth{fleet=a}``
+def counter(name, **labels):
+    return REGISTRY.counter(labelled(name, labels))
+
+
+def gauge(name, **labels):
+    return REGISTRY.gauge(labelled(name, labels))
+
+
+def histogram(name, **labels):
+    return REGISTRY.histogram(labelled(name, labels))
 
 
 def prefixed(prefix, registry=None):

@@ -502,6 +502,65 @@ def region_summary(root):
     return latest
 
 
+def slo_summary(root):
+    """SLO posture for the round record: the latest committed bench
+    record carrying an ``slo`` stamp (``bench.py --serve-trace`` /
+    ``--region-trace``) reduced to the judgment surface — the overall
+    burn-rate verdict and per-class fast/slow burns
+    (diagnostics/slo.py), the request-waterfall completeness ledger
+    (every completed request must render a fully linked, orphan-free
+    waterfall), and the measured tracing overhead, which the doctor
+    FAILs at >= 5%.  ``None`` when no round carries an SLO stamp;
+    never raises."""
+    latest = None
+    try:
+        for pattern in ROUND_GLOBS:
+            for path in sorted(glob.glob(os.path.join(root, pattern)),
+                               key=_round_key):
+                try:
+                    with open(path) as f:
+                        rec = json.load(f).get('parsed') or {}
+                except (OSError, ValueError):
+                    continue
+                slo = rec.get('slo')
+                if not isinstance(slo, dict):
+                    continue
+                classes = {}
+                for cname, c in (slo.get('classes') or {}).items():
+                    wins = c.get('windows') or {}
+                    classes[cname] = {
+                        'verdict': c.get('verdict'),
+                        'total': c.get('total'),
+                        'shed': c.get('shed'),
+                        'bad': c.get('bad'),
+                        'p99_s': c.get('p99_s'),
+                        'fast_burn': (wins.get('fast') or {})
+                        .get('burn'),
+                        'slow_burn': (wins.get('slow') or {})
+                        .get('burn'),
+                    }
+                wf = rec.get('waterfalls') \
+                    if isinstance(rec.get('waterfalls'), dict) else {}
+                ov = rec.get('trace_overhead') \
+                    if isinstance(rec.get('trace_overhead'), dict) \
+                    else {}
+                latest = {
+                    'round': os.path.basename(path),
+                    'metric': rec.get('metric'),
+                    'verdict': slo.get('verdict'),
+                    'classes': classes,
+                    'traces': wf.get('traces'),
+                    'complete': wf.get('complete'),
+                    'complete_fraction': wf.get('complete_fraction'),
+                    'orphan_spans': wf.get('orphan_spans'),
+                    'overhead': ov.get('overhead'),
+                    'overhead_n': ov.get('n'),
+                }
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+    return latest
+
+
 def integrity_summary(root):
     """Data-integrity posture for the round record
     (docs/INTEGRITY.md): every committed record carrying an
@@ -714,6 +773,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'region': region_summary(root),
         'ingest': ingest_summary(root),
         'integrity': integrity_summary(root),
+        'slo': slo_summary(root),
         'precision': precision_summary(root, now=now),
         'caches': load_caches(root, stale_hours=stale_hours, now=now),
         'summary': {v: sum(1 for e in entries
@@ -897,6 +957,32 @@ def render_regress(history):
                  integ.get('shadow_verified', 0),
                  integ.get('shadow_mismatch', 0),
                  ' — %s' % '; '.join(bits) if bits else ''))
+    slo = history.get('slo')
+    if slo is not None:
+        if 'error' in slo:
+            w('  slo: unavailable (%s)' % slo['error'])
+        else:
+            bits = []
+            for cname, c in sorted((slo.get('classes') or {}).items()):
+                bits.append('%s %s (burn fast %s / slow %s, p99 %ss)'
+                            % (cname, c.get('verdict', '?'),
+                               c.get('fast_burn', '?'),
+                               c.get('slow_burn', '?'),
+                               c.get('p99_s', '?')))
+            extra = []
+            if slo.get('orphan_spans'):
+                extra.append('%s ORPHAN span(s)' % slo['orphan_spans'])
+            ov = slo.get('overhead')
+            if ov is not None:
+                extra.append('tracing overhead %.1f%%%s'
+                             % (100.0 * ov,
+                                ' — OVER the 5%% budget'
+                                if ov >= 0.05 else ''))
+            w('  slo: %s — %s/%s waterfall(s) complete%s%s'
+              % (slo.get('verdict', '?'), slo.get('complete', '?'),
+                 slo.get('traces', '?'),
+                 '; %s' % '; '.join(bits) if bits else '',
+                 '; %s' % '; '.join(extra) if extra else ''))
     prec = history.get('precision')
     if prec is not None:
         if 'error' in prec:

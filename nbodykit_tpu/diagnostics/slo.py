@@ -1,0 +1,262 @@
+"""SLO objectives + multi-window burn-rate monitoring per tenant class.
+
+The region stack already *measures* everything — per-request latency,
+verdict counters, QoS throttles — but nothing *judges* it: a fleet can
+quietly serve every interactive request in 80 s and no gate trips
+until a human reads a bench table.  This module is the judgment layer:
+per-tenant-class objectives (latency threshold + target fraction,
+availability target), and **burn rates** over two windows computed
+from the observation stream the serve/region ``_finish`` paths feed.
+
+Burn rate is the SRE-standard normalization: the rate at which the
+error budget (``1 - target``) is being consumed, so ``burn == 1``
+means "exactly on budget" for every target.  Two windows give the
+standard page/ticket split:
+
+- **fast** (5 min): ``burn >= 14.4`` means the monthly budget dies in
+  ~2 days — a page, rendered as doctor **FAIL**;
+- **slow** (1 h): ``burn >= 1.0`` means the budget is on track to be
+  exhausted — a ticket, rendered as doctor **WARN**.
+
+Windows anchor on the *last observation*, not on wall-clock "now" — a
+bench trace replayed in 3 s and a day-long serve log produce the same
+verdicts for the same shape of traffic, and tests need no sleeps.
+
+What counts against availability is deliberate: failures and
+deadline evictions are *bad* (the tenant asked and the region did not
+deliver); QoS throttles and admission rejections are *load shedding*
+— the region working as designed — and count only against the
+``shed`` tally, never the budget.  ``tests/test_observability.py``
+holds both properties.
+"""
+
+import threading
+import time
+from collections import deque
+
+#: (window name, seconds, burn threshold, verdict when exceeded)
+WINDOWS = (('fast', 300.0, 14.4, 'FAIL'),
+           ('slow', 3600.0, 1.0, 'WARN'))
+
+#: terminal statuses that consume error budget (the tenant asked, the
+#: region did not deliver)
+BAD_STATUSES = ('failed', 'deadline_evicted')
+#: terminal statuses that are load shedding, not failure
+SHED_STATUSES = ('rejected', 'qos_throttled', 'qos_unavailable',
+                 'cancelled')
+
+
+class SLObjective(object):
+    """One class's objectives: ``latency_s`` at ``latency_target``
+    (fraction of deliveries under the threshold) and
+    ``availability_target`` (fraction of non-shed requests
+    delivered)."""
+
+    __slots__ = ('class_name', 'latency_s', 'latency_target',
+                 'availability_target')
+
+    def __init__(self, class_name, latency_s, latency_target=0.99,
+                 availability_target=0.999):
+        self.class_name = str(class_name)
+        self.latency_s = float(latency_s)
+        if not 0.0 < latency_target < 1.0:
+            raise ValueError('latency_target must be in (0, 1), got %r'
+                             % (latency_target,))
+        if not 0.0 < availability_target < 1.0:
+            raise ValueError('availability_target must be in (0, 1), '
+                             'got %r' % (availability_target,))
+        self.latency_target = float(latency_target)
+        self.availability_target = float(availability_target)
+
+    def to_dict(self):
+        return {'class': self.class_name, 'latency_s': self.latency_s,
+                'latency_target': self.latency_target,
+                'availability_target': self.availability_target}
+
+    def __repr__(self):
+        return ('SLObjective(%r, latency_s=%r, latency_target=%r, '
+                'availability_target=%r)'
+                % (self.class_name, self.latency_s,
+                   self.latency_target, self.availability_target))
+
+
+#: Default objectives, sized for the CPU bench meshes this repo can
+#: actually run (a TPU deployment overrides these with real numbers).
+DEFAULT_SLOS = (
+    SLObjective('interactive', latency_s=30.0),
+    SLObjective('batch', latency_s=60.0),
+    SLObjective('bulk', latency_s=120.0, latency_target=0.95),
+)
+
+
+class SLOPolicy(object):
+    """Class-name -> :class:`SLObjective` mapping; unmapped classes
+    fall to ``default`` (an :class:`SLObjective` or None = judged
+    against a 60 s / three-nines catch-all)."""
+
+    def __init__(self, objectives=None, default=None):
+        objs = list(objectives if objectives is not None
+                    else DEFAULT_SLOS)
+        self.objectives = {o.class_name: o for o in objs}
+        self.default = default if default is not None \
+            else SLObjective('default', latency_s=60.0)
+
+    def objective(self, class_name):
+        return self.objectives.get(str(class_name), self.default)
+
+    def to_dict(self):
+        return {'objectives':
+                [o.to_dict() for _, o in sorted(self.objectives.items())],
+                'default': self.default.to_dict()}
+
+
+class _ClassWindow(object):
+    """Per-class observation ring: (t, latency_bad, avail_bad)."""
+
+    __slots__ = ('obs', 'total', 'delivered', 'shed', 'latency_bad',
+                 'avail_bad', 'latencies')
+
+    def __init__(self, maxlen):
+        self.obs = deque(maxlen=maxlen)
+        self.total = 0
+        self.delivered = 0
+        self.shed = 0
+        self.latency_bad = 0
+        self.avail_bad = 0
+        self.latencies = deque(maxlen=maxlen)
+
+
+class SLOTracker(object):
+    """Accumulates per-class observations and computes windowed burn.
+
+    ``observe`` is what the serve/region ``_finish`` paths call once
+    per terminal request; everything else is read-side.  Thread-safe;
+    ``maxlen`` bounds per-class memory (old observations age out of
+    the windows anyway).
+    """
+
+    def __init__(self, policy=None, maxlen=65536):
+        self.policy = policy if policy is not None else SLOPolicy()
+        self._lock = threading.Lock()
+        self._classes = {}
+        self._maxlen = int(maxlen)
+        self._last_t = None
+
+    def _cls(self, class_name):
+        cw = self._classes.get(class_name)
+        if cw is None:
+            cw = self._classes[class_name] = _ClassWindow(self._maxlen)
+        return cw
+
+    def observe(self, class_name, latency_s=None, status='completed',
+                t=None):
+        """Record one terminal request: ``status`` is the serve/region
+        terminal verdict; ``latency_s`` the delivery latency (None for
+        non-delivered).  ``t`` defaults to wall-clock now (tests pass
+        explicit times)."""
+        if t is None:
+            t = time.time()
+        class_name = str(class_name)
+        obj = self.policy.objective(class_name)
+        shed = status in SHED_STATUSES
+        avail_bad = (not shed) and status in BAD_STATUSES
+        latency_bad = (status == 'completed' and latency_s is not None
+                       and float(latency_s) > obj.latency_s)
+        with self._lock:
+            cw = self._cls(class_name)
+            cw.total += 1
+            if shed:
+                cw.shed += 1
+            elif avail_bad:
+                cw.avail_bad += 1
+            else:
+                cw.delivered += 1
+            if latency_bad:
+                cw.latency_bad += 1
+            if latency_s is not None:
+                cw.latencies.append(float(latency_s))
+            cw.obs.append((float(t), bool(latency_bad),
+                           bool(avail_bad), bool(shed)))
+            if self._last_t is None or t > self._last_t:
+                self._last_t = float(t)
+
+    # -- read side --------------------------------------------------------
+
+    @staticmethod
+    def _burn(bad, total, budget):
+        """Error-budget consumption rate: observed error rate over the
+        allowed error rate.  No traffic = no burn."""
+        if total <= 0:
+            return 0.0
+        return (bad / float(total)) / budget
+
+    def _windows(self, cw, obj, anchor):
+        out = {}
+        for wname, seconds, threshold, verdict in WINDOWS:
+            lo = anchor - seconds
+            total = lat_n = lat_bad = av_n = av_bad = 0
+            for (t, lbad, abad, shed) in cw.obs:
+                if t < lo:
+                    continue
+                total += 1
+                if not shed:
+                    av_n += 1
+                    if abad:
+                        av_bad += 1
+                if not shed and not abad:
+                    lat_n += 1
+                    if lbad:
+                        lat_bad += 1
+            lat_burn = self._burn(lat_bad, lat_n,
+                                  1.0 - obj.latency_target)
+            av_burn = self._burn(av_bad, av_n,
+                                 1.0 - obj.availability_target)
+            out[wname] = {'seconds': seconds, 'events': total,
+                          'latency_burn': round(lat_burn, 4),
+                          'availability_burn': round(av_burn, 4),
+                          'burn': round(max(lat_burn, av_burn), 4),
+                          'threshold': threshold}
+        return out
+
+    @staticmethod
+    def _verdict(windows):
+        for wname, seconds, threshold, verdict in WINDOWS:
+            w = windows.get(wname)
+            if w and w['burn'] >= threshold:
+                return verdict
+        return 'OK'
+
+    def snapshot(self):
+        """Everything the export plane / bench stamp / doctor need:
+        per-class totals, two-window burns, per-class and overall
+        verdicts."""
+        with self._lock:
+            anchor = self._last_t if self._last_t is not None \
+                else time.time()
+            classes = {}
+            worst = 'OK'
+            rank = {'OK': 0, 'WARN': 1, 'FAIL': 2}
+            for name in sorted(self._classes):
+                cw = self._classes[name]
+                obj = self.policy.objective(name)
+                windows = self._windows(cw, obj, anchor)
+                verdict = self._verdict(windows)
+                if rank[verdict] > rank[worst]:
+                    worst = verdict
+                lat = sorted(cw.latencies)
+                classes[name] = {
+                    'objective': obj.to_dict(),
+                    'total': cw.total, 'delivered': cw.delivered,
+                    'shed': cw.shed, 'bad': cw.avail_bad,
+                    'latency_bad': cw.latency_bad,
+                    'p99_s': round(lat[min(len(lat) - 1,
+                                           int(0.99 * len(lat)))], 6)
+                    if lat else None,
+                    'windows': windows, 'verdict': verdict,
+                }
+            return {'classes': classes, 'verdict': worst,
+                    'anchor_ts': round(anchor, 6)}
+
+    def verdict(self):
+        """'OK' | 'WARN' | 'FAIL' across every class."""
+        return self.snapshot()['verdict']

@@ -38,6 +38,9 @@ post-mortem from one that merely had no spans to emit.
 """
 
 import atexit
+import contextlib
+import contextvars
+import hashlib
 import json
 import os
 import sys
@@ -46,6 +49,89 @@ import time
 
 _lock = threading.Lock()
 _tracer = None
+
+#: The ambient request context.  A contextvar — NOT inherited by
+#: long-lived worker threads (they were created before any request
+#: existed), so the serve stack carries the context on its tickets and
+#: re-activates it with :func:`trace_scope` at every thread hop it
+#: owns.  That explicitness is the point: a hop the code forgot shows
+#: up as an orphan span in ``analyze.py``'s request report.
+_CTX = contextvars.ContextVar('nbkit_request_ctx', default=None)
+
+#: Span names at or above these prefixes are *request-level*: they are
+#: always recorded, even for requests outside the exemplar sample.
+#: Everything else (kernel-depth spans: paint, fft.*, compile.*) is
+#: dropped for unsampled requests — cheap envelopes for the many, full
+#: waterfalls for the hash-chosen few.
+_REQUEST_LEVEL = ('serve.', 'region.', 'resilience.')
+
+
+class RequestContext(object):
+    """W3C-style causal identity for one request: a ``trace_id``
+    shared by every span the request causes (across threads and
+    processes), the root span's id (``span_id``) that cross-thread
+    spans re-parent to via the ``rpar`` field, and the exemplar
+    ``sampled`` bit."""
+
+    __slots__ = ('trace_id', 'span_id', 'sampled')
+
+    def __init__(self, trace_id, span_id=0, sampled=True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def __repr__(self):
+        return 'RequestContext(%r, span_id=%r, sampled=%r)' % (
+            self.trace_id, self.span_id, self.sampled)
+
+
+def exemplar_fraction():
+    """Fraction of requests recorded at full kernel depth
+    (``NBKIT_TRACE_EXEMPLAR``, default 1.0, clamped to [0, 1]).
+    Requests outside the sample still emit their request-level spans
+    (:data:`_REQUEST_LEVEL`), so every waterfall is complete — only
+    the kernel interior is elided."""
+    try:
+        f = float(os.environ.get('NBKIT_TRACE_EXEMPLAR', '1') or 1.0)
+    except ValueError:
+        return 1.0
+    return min(1.0, max(0.0, f))
+
+
+def new_request_context(request_id, fraction=None):
+    """Mint the :class:`RequestContext` for ``request_id``.
+
+    The trace id is a hash of the request id — deterministic, so a
+    replayed request lands on the same trace id (and the same exemplar
+    decision) in every process that handles it, with zero
+    coordination.  ``span_id`` starts 0; the owner assigns it from the
+    root span after entering it."""
+    trace_id = hashlib.blake2b(str(request_id).encode('utf-8'),
+                               digest_size=8).hexdigest()
+    if fraction is None:
+        fraction = exemplar_fraction()
+    sampled = (int(trace_id[:8], 16) % 10000) < int(fraction * 10000)
+    return RequestContext(trace_id, 0, sampled)
+
+
+def trace_context():
+    """The ambient :class:`RequestContext`, or None."""
+    return _CTX.get()
+
+
+@contextlib.contextmanager
+def trace_scope(ctx):
+    """Activate ``ctx`` as the ambient request context for the
+    duration of the block.  ``ctx=None`` is a no-op (so call sites at
+    thread hops can wrap unconditionally)."""
+    if ctx is None:
+        yield None
+        return
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)
 
 
 class _NullSpan(object):
@@ -57,6 +143,10 @@ class _NullSpan(object):
     """
 
     __slots__ = ()
+
+    #: uniform with :class:`_Span` so ``span(...).span_id`` is safe on
+    #: the disabled path (0 = "no span": never a real id)
+    span_id = 0
 
     def __enter__(self):
         return self
@@ -150,12 +240,19 @@ class _Span(object):
     :meth:`set` land in the trace record's ``attrs``."""
 
     __slots__ = ('_tr', 'name', 'attrs', '_id', '_par', '_depth',
-                 '_ts', '_tm')
+                 '_ts', '_tm', '_ctx')
 
     def __init__(self, tr, name, attrs):
         self._tr = tr
         self.name = name
         self.attrs = dict(attrs) if attrs else None
+        self._id = 0
+
+    @property
+    def span_id(self):
+        """The span's id once entered (0 before) — what a
+        :class:`RequestContext` records as its root."""
+        return self._id
 
     def set(self, **attrs):
         if self.attrs is None:
@@ -163,21 +260,36 @@ class _Span(object):
         self.attrs.update(attrs)
         return self
 
+    def _stamp(self, rec):
+        ctx = self._ctx
+        if ctx is not None:
+            rec['trace'] = ctx.trace_id
+            # cross-thread re-parenting: a span opened on an empty
+            # per-thread stack hangs off the request's root span, not
+            # off nothing — 'rpar' is the remote parent the request
+            # report resolves across thread/process boundaries
+            if self._par == 0 and ctx.span_id \
+                    and ctx.span_id != self._id:
+                rec['rpar'] = ctx.span_id
+
     def __enter__(self):
         tr = self._tr
         st = tr._stack()
         self._id = tr._new_id()
         self._par = st[-1]._id if st else 0
         self._depth = len(st)
+        self._ctx = _CTX.get()
         st.append(self)
         self._ts = time.time()
         self._tm = time.perf_counter()
         # begin event: flushed (not fsynced — an OS-level flush already
         # survives a SIGKILL of this process) so a post-mortem shows
         # what was IN FLIGHT when the run died, not just what finished
-        tr._emit({'t': 'b', 'id': self._id, 'par': self._par,
-                  'name': self.name, 'ts': round(self._ts, 6),
-                  'depth': self._depth, 'pid': tr.pid}, sync=False)
+        rec = {'t': 'b', 'id': self._id, 'par': self._par,
+               'name': self.name, 'ts': round(self._ts, 6),
+               'depth': self._depth, 'pid': tr.pid}
+        self._stamp(rec)
+        tr._emit(rec, sync=False)
         return self
 
     def __exit__(self, etype, evalue, tb):
@@ -195,6 +307,7 @@ class _Span(object):
                'name': self.name, 'ts': round(self._ts, 6),
                'dur': round(dur, 6), 'depth': self._depth,
                'pid': tr.pid, 'ok': etype is None}
+        self._stamp(rec)
         if etype is not None:
             rec['exc'] = '%s: %s' % (getattr(etype, '__name__', etype),
                                      evalue)
@@ -226,6 +339,11 @@ class Tracer(object):
         self._wlock = threading.Lock()
         self._tls = threading.local()
         self._next_id = 0
+        # NBKIT_DIAGNOSTICS_SYNC=0 drops the per-span fsync (flush
+        # only — still survives a SIGKILL of this process, loses only
+        # on kernel/power death).  The bench overhead gate runs here.
+        self.sync = os.environ.get('NBKIT_DIAGNOSTICS_SYNC',
+                                   '1') != '0'
         try:
             self.heartbeat_s = float(os.environ.get(
                 'NBKIT_DIAGNOSTICS_HEARTBEAT', '5') or 0)
@@ -268,7 +386,7 @@ class Tracer(object):
                 return
             f.write(line)
             f.flush()
-            if sync:
+            if sync and self.sync:
                 try:
                     os.fsync(f.fileno())
                 except OSError:     # pragma: no cover - exotic fs
@@ -307,25 +425,41 @@ class Tracer(object):
     # -- API --------------------------------------------------------------
 
     def span(self, name, attrs=None):
+        # exemplar sampling: for requests outside the sample, only
+        # request-level spans are recorded — the kernel interior
+        # (paint, fft.*, binning, ...) costs nothing
+        ctx = _CTX.get()
+        if ctx is not None and not ctx.sampled \
+                and not name.startswith(_REQUEST_LEVEL):
+            return NULL_SPAN
         return _Span(self, name, attrs)
 
-    def event(self, name, attrs=None, ok=True):
+    def event(self, name, attrs=None, ok=True, ctx=None):
         """Record an instantaneous event as a zero-duration span at
         *now* — the form the resilience supervisor uses for retry /
         degrade / resume marks, so they land in the merged timeline
         (and straggler/critical-path tables) like any other span."""
-        self.emit_span(name, time.time(), 0.0, attrs=attrs, ok=ok)
+        self.emit_span(name, time.time(), 0.0, attrs=attrs, ok=ok,
+                       ctx=ctx)
 
-    def emit_span(self, name, ts, dur, attrs=None, ok=True):
+    def emit_span(self, name, ts, dur, attrs=None, ok=True, ctx=None):
         """Record a completed span observed out-of-band — e.g. a compile
         reported after the fact by ``jax.monitoring`` (metrics.py), where
         there was no way to enter a context manager before the work ran.
         ``ts`` is the wall-clock start, ``dur`` the duration in seconds;
-        the record is a normal top-level span to every reader."""
+        the record is a normal top-level span to every reader.  The
+        ambient request context (or an explicit ``ctx``) stamps the
+        record into its request's trace."""
         rec = {'t': 'span', 'id': self._new_id(), 'par': 0,
                'name': name, 'ts': round(float(ts), 6),
                'dur': round(float(dur), 6), 'depth': 0,
                'pid': self.pid, 'ok': bool(ok)}
+        if ctx is None:
+            ctx = _CTX.get()
+        if ctx is not None:
+            rec['trace'] = ctx.trace_id
+            if ctx.span_id:
+                rec['rpar'] = ctx.span_id
         if attrs:
             rec['attrs'] = dict(attrs)
         self._emit(rec)

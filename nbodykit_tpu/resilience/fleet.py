@@ -287,6 +287,11 @@ def _announce_preemption():
     if tr is not None:
         tr.event('resilience.preempted',
                  {'grace_s': grace, 'deadline': round(deadline, 3)})
+    # seal the flight recorder while there is still grace left: the
+    # post-mortem gets the last N request waterfalls even if the
+    # grace timer force-exits before any server drains
+    from ..diagnostics.export import FLIGHT
+    FLIGHT.dump('preempt.sigterm')
 
 
 def _grace_expired():
@@ -295,6 +300,8 @@ def _grace_expired():
             return
         code = _preempt['exit_code']
     counter('resilience.preempt_forced').add(1)
+    from ..diagnostics.export import FLIGHT
+    FLIGHT.dump('preempt.grace_expired')
     tr = current_tracer()
     if tr is not None:
         tr.event('resilience.preempt_forced', {'exit_code': code})

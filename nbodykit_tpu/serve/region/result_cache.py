@@ -61,6 +61,7 @@ JIT_OPTIONS = (
 RUNTIME_OPTIONS = (
     'diagnostics', 'faults', 'tune_cache', 'io_verify_checksums',
     'ingest_overlap', 'ingest_cache_bytes', 'data_steal_grace_s',
+    'telemetry_port',
 )
 
 

@@ -40,7 +40,12 @@ import heapq
 import threading
 import time
 
-from ...diagnostics import counter, gauge, span
+from ...diagnostics import (counter, current_tracer, gauge,
+                            new_request_context, span, trace_context,
+                            trace_scope)
+from ...diagnostics.export import FLIGHT, ensure_exporter, \
+    register_source
+from ...diagnostics.slo import SLOTracker
 from ...resilience.faults import corrupt_spec
 from ..scheduler import affinity
 from ..server import COMPLETED, EVICTED, REJECTED, RequestResult
@@ -55,6 +60,11 @@ class Fleet(object):
     def __init__(self, name, server):
         self.name = str(name)
         self.server = server
+        if getattr(server, 'name', None) is None \
+                and hasattr(server, 'set_name'):
+            # label the member for the export plane: its queue-depth
+            # gauges and SLO source carry this fleet name from now on
+            server.set_name(self.name)
 
     def load(self):
         """The router's health/load probe: the fleet's live queue
@@ -153,8 +163,10 @@ class RegionRouter(object):
     def _depth(fleet):
         try:
             state = fleet.load()
-            return int(state.get('queued', 0)) \
+            depth = int(state.get('queued', 0)) \
                 + int(state.get('inflight', 0))
+            gauge('region.fleet.load', fleet=fleet.name).set(depth)
+            return depth
         except Exception:       # pragma: no cover - dying fleet
             return 1 << 30
 
@@ -221,7 +233,7 @@ class RegionTicket(object):
     __slots__ = ('request', 'tenant', 'class_name', 'throttleable',
                  'submitted_at', 'seq', 'verdict', 'digest',
                  'key_text', 'fleet', 'inner', 'done', 'dispatched',
-                 'result', 'followers')
+                 'result', 'followers', 'ctx', 'ctx_owned')
 
     def __init__(self, request, tenant, submitted_at, seq):
         self.request = request
@@ -242,6 +254,11 @@ class RegionTicket(object):
         # are served from this leader's committed result.  None once
         # the leader has finished (sealed — late arrivals recompute).
         self.followers = []
+        # the request's trace context, carried explicitly because the
+        # pacer and leader-finish threads predate every request — the
+        # contextvar cannot reach them (diagnostics/trace.py)
+        self.ctx = None
+        self.ctx_owned = False
 
 
 class Region(object):
@@ -299,6 +316,9 @@ class Region(object):
         self._unverified_as_verified = 0
         self._leaders = {}      # digest -> inflight leader ticket
         self._joins = []
+        self.slo = SLOTracker()
+        register_source('region', self.slo.snapshot)
+        ensure_exporter()
         self._pacer = threading.Thread(target=self._pace,
                                        name='region-pacer',
                                        daemon=True)
@@ -374,6 +394,27 @@ class Region(object):
             ticket = RegionTicket(request, tenant, now, self._seq)
             self._tickets.append(ticket)
             accepting = self._accepting
+        # trace identity: the region is the outermost front door, so
+        # it normally mints the request's context here (adopting an
+        # ambient one only when a caller nested us inside a trace)
+        ctx = trace_context()
+        owns_ctx = ctx is None
+        if owns_ctx and current_tracer() is not None:
+            ctx = new_request_context(request.request_id)
+        ticket.ctx = ctx
+        ticket.ctx_owned = bool(owns_ctx)
+        with trace_scope(ctx if owns_ctx else None), \
+                span('region.submit', request_id=request.request_id,
+                     tenant=ticket.tenant,
+                     algorithm=request.algorithm) as sp:
+            if owns_ctx and ctx is not None and not ctx.span_id:
+                # this span IS the request's root: every cross-thread
+                # span re-parents to it via ctx.span_id
+                ctx.span_id = sp.span_id
+            return self._submit_gated(ticket, request, tenant, now,
+                                      accepting)
+
+    def _submit_gated(self, ticket, request, tenant, now, accepting):
         if not accepting:
             self._finish(ticket, RequestResult(
                 request.request_id, REJECTED,
@@ -407,6 +448,19 @@ class Region(object):
                     self._routed['follower'] = \
                         self._routed.get('follower', 0) + 1
                     counter('region.result_cache.followers').add(1)
+                    tr = current_tracer()
+                    if tr is not None and ticket.ctx is not None \
+                            and leader.ctx is not None:
+                        # zero-duration link span: ties the follower's
+                        # waterfall to the leader's trace it rides on
+                        tr.emit_span(
+                            'region.singleflight.follower',
+                            time.time(), 0.0,
+                            {'request_id': request.request_id,
+                             'leader_trace': leader.ctx.trace_id,
+                             'leader_request':
+                                 leader.request.request_id},
+                            ctx=ticket.ctx)
                     return ticket
                 self._leaders[digest] = ticket
         # 2. the QoS gate
@@ -481,6 +535,12 @@ class Region(object):
         with self._lock:
             self._routed['result_cache'] = \
                 self._routed.get('result_cache', 0) + 1
+        tr = current_tracer()
+        if tr is not None and ticket.ctx is not None:
+            tr.emit_span('region.cache.hit', time.time(), 0.0,
+                         {'request_id': ticket.request.request_id,
+                          'digest': ticket.digest,
+                          'verified': stamped}, ctx=ticket.ctx)
         self._finish(ticket, RequestResult(
             ticket.request.request_id, COMPLETED,
             x=entry['x'], y=entry['y'], nmodes=entry['nmodes'],
@@ -491,8 +551,15 @@ class Region(object):
             shape_class=ticket.request.shape_class))
 
     def _dispatch(self, ticket):
-        """Route and hand ``ticket`` to its fleet (submit thread or
-        pacer thread)."""
+        """Route and hand ``ticket`` to its fleet (submit thread,
+        pacer thread, or a leader's finishing thread).  Runs under the
+        ticket's trace scope so ``region.route`` — and the fleet's
+        whole ``serve.submit`` subtree — land in the request's trace
+        whichever thread dispatches it."""
+        with trace_scope(ticket.ctx):
+            self._dispatch_traced(ticket)
+
+    def _dispatch_traced(self, ticket):
         now = time.monotonic()
         if now >= ticket.submitted_at + ticket.request.deadline_s:
             self._finish(ticket, RequestResult(
@@ -547,6 +614,19 @@ class Region(object):
                     continue
                 heapq.heappop(self._held)
                 gauge('region.qos.held').set(len(self._held))
+            tr = current_tracer()
+            if tr is not None and ticket.ctx is not None:
+                # the hold is over: stamp it retroactively as one
+                # out-of-band span covering submit -> due-time
+                held_s = max(time.monotonic() - ticket.submitted_at,
+                             0.0)
+                tr.emit_span('region.qos.hold',
+                             time.time() - held_s, held_s,
+                             {'request_id':
+                                  ticket.request.request_id,
+                              'tenant': ticket.tenant,
+                              'class': ticket.class_name},
+                             ctx=ticket.ctx)
             self._dispatch(ticket)
 
     # -- harvest ----------------------------------------------------------
@@ -584,10 +664,15 @@ class Region(object):
             # verified == this exact execution was shadow-compared
             # on a second sub-mesh and delivered (a mismatch would
             # have retried or failed before reaching here)
-            self.cache.put(ticket.digest, ticket.key_text,
-                           res.x, res.y, res.nmodes,
-                           verified=bool(getattr(ticket.inner,
-                                                 'verify', False)))
+            with trace_scope(ticket.ctx), \
+                    span('region.cache.commit',
+                         request_id=res.request_id,
+                         digest=ticket.digest):
+                self.cache.put(ticket.digest, ticket.key_text,
+                               res.x, res.y, res.nmodes,
+                               verified=bool(getattr(ticket.inner,
+                                                     'verify',
+                                                     False)))
         events = list(res.events)
         events.append(dict(ticket.verdict or {}, kind='route'))
         self._finish(ticket, RequestResult(
@@ -630,6 +715,40 @@ class Region(object):
                 counter('region.qos.starved').add(1)
             ticket.result = result
         counter('region.%s' % result.status).add(1)
+        reason_code = (result.reason or {}).get('code')
+        if result.status == COMPLETED:
+            slo_status = 'completed'
+        elif result.status == EVICTED:
+            slo_status = ('deadline_evicted'
+                          if reason_code == 'deadline'
+                          else 'qos_throttled'
+                          if reason_code == 'qos_throttled'
+                          else 'cancelled')
+        elif result.status == REJECTED:
+            slo_status = ('qos_unavailable'
+                          if reason_code == 'qos_unavailable'
+                          else 'rejected')
+        else:
+            slo_status = result.status      # 'failed'
+        self.slo.observe(cls, result.latency_s, slo_status)
+        tr = current_tracer()
+        if tr is not None and ticket.ctx is not None:
+            tr.event('region.deliver',
+                     {'request_id': result.request_id,
+                      'status': result.status,
+                      'latency_s': result.latency_s},
+                     ctx=ticket.ctx)
+        if ticket.ctx_owned:
+            # this region owns the request's flight-recorder entry
+            # (the fleet underneath sees an adopted context and
+            # stays quiet)
+            FLIGHT.record({
+                'request_id': result.request_id,
+                'trace': ticket.ctx.trace_id if ticket.ctx else None,
+                'layer': 'region', 'status': result.status,
+                'class': cls, 'tenant': ticket.tenant,
+                'slo_status': slo_status,
+                'latency_s': result.latency_s})
         ticket.done.set()
         ticket.dispatched.set()
         with self._cv:
@@ -759,6 +878,7 @@ class Region(object):
                     'qos_evicted': qos_evicted,
                     'starved': starved},
             'by_class': by_class,
+            'slo': self.slo.snapshot(),
             'elastic': {'joins': len(joins),
                         'rehomed': self.router.rehomed,
                         'events': joins},

@@ -43,7 +43,12 @@ histogram keeps only moments).
 import threading
 import time
 
-from ..diagnostics import counter, gauge, histogram, span
+from ..diagnostics import (counter, current_tracer, gauge, histogram,
+                           new_request_context, span, trace_context,
+                           trace_scope)
+from ..diagnostics.export import FLIGHT, ensure_exporter, \
+    register_source
+from ..diagnostics.slo import SLOTracker
 from ..parallel.runtime import mesh_size
 from .admission import REJECT, admit
 from .batching import BatchPolicy, close_window, compatible, pad_seeds
@@ -85,17 +90,23 @@ class RequestResult(object):
 
     __slots__ = ('request_id', 'status', 'x', 'y', 'nmodes', 'reason',
                  'latency_s', 'events', 'options', 'admit_options',
-                 'batch_size', 'algorithm', 'shape_class')
+                 'batch_size', 'algorithm', 'shape_class',
+                 'queue_wait_s', 'service_s')
 
     def __init__(self, request_id, status, x=None, y=None, nmodes=None,
                  reason=None, latency_s=None, events=None, options=None,
                  admit_options=None, batch_size=0, algorithm=None,
-                 shape_class=None):
+                 shape_class=None, queue_wait_s=None, service_s=None):
         self.request_id = request_id
         self.status = status
         self.x, self.y, self.nmodes = x, y, nmodes
         self.reason = reason
         self.latency_s = latency_s
+        # the latency split: time queued before a worker picked the
+        # ticket vs time actually executing; latency_s remains the
+        # combined end-to-end number for record compatibility
+        self.queue_wait_s = queue_wait_s
+        self.service_s = service_s
         self.events = list(events or [])
         # options: everything applied around the run (tuned winners +
         # overrides); admit_options: ONLY what admission stepped down
@@ -115,6 +126,8 @@ class RequestResult(object):
     def to_dict(self):
         out = {'request_id': self.request_id, 'status': self.status,
                'latency_s': self.latency_s,
+               'queue_wait_s': self.queue_wait_s,
+               'service_s': self.service_s,
                'batch_size': self.batch_size,
                'algorithm': self.algorithm,
                'shape_class': self.shape_class,
@@ -133,10 +146,11 @@ class RequestResult(object):
 
 class _Ticket(object):
     __slots__ = ('request', 'decision', 'submitted_at', 'deadline_at',
-                 'seq', 'affinity', 'done', 'result', 'verify')
+                 'seq', 'affinity', 'done', 'result', 'verify', 'ctx',
+                 'ctx_owned')
 
     def __init__(self, request, decision, submitted_at, seq, aff,
-                 verify=False):
+                 verify=False, ctx=None, ctx_owned=False):
         self.request = request
         self.decision = decision
         self.submitted_at = submitted_at
@@ -146,6 +160,11 @@ class _Ticket(object):
         self.done = threading.Event()
         self.result = None
         self.verify = bool(verify)
+        # the request's trace context, carried explicitly because
+        # worker threads outlive (and predate) every request — the
+        # contextvar cannot reach them (trace.py)
+        self.ctx = ctx
+        self.ctx_owned = bool(ctx_owned)
 
 
 class AnalysisServer(object):
@@ -181,7 +200,7 @@ class AnalysisServer(object):
 
     def __init__(self, per_task=1, max_queue=256, hbm_bytes=16e9,
                  batch=None, checkpoint=None, retry=None,
-                 verify_fraction=0.0):
+                 verify_fraction=0.0, name=None):
         from ..batch import TaskManager
         from ..parallel.runtime import (CurrentMesh, cpu_mesh,
                                         tpu_mesh, use_mesh)
@@ -233,7 +252,19 @@ class AnalysisServer(object):
 
         self.results = {}
         self._latencies = []
+        self._queue_waits = []
+        self._service_times = []
         self._submitted = 0
+        # the fleet label for the export plane's per-fleet gauges
+        # (serve.queue_depth{fleet=...}); a Region names its fleets,
+        # a standalone server may pass name= itself
+        self.name = str(name) if name else None
+        # per-shape-class SLO burn tracking; a Region layers its own
+        # per-tenant-class tracker above this one
+        self.slo = SLOTracker()
+        register_source('serve%s' % ('.' + self.name if self.name
+                                     else ''), self.slo.snapshot)
+        ensure_exporter()
 
         self._threads = [
             threading.Thread(target=self._worker, args=(i,),
@@ -243,6 +274,24 @@ class AnalysisServer(object):
             t.start()
 
     # -- lifecycle --------------------------------------------------------
+
+    def set_name(self, name):
+        """Label this fleet for the export plane (a Region names its
+        member fleets at wrap time); re-registers the SLO source under
+        the labelled name."""
+        self.name = str(name)
+        register_source('serve.' + self.name, self.slo.snapshot)
+        return self
+
+    def _depth_gauge(self, depth, inflight=None):
+        """The queue-depth (and optionally inflight) gauges, both the
+        process-global compatibility name and the per-fleet labelled
+        series the router's spill decisions are audited against."""
+        gauge('serve.queue_depth').set(depth)
+        if self.name:
+            gauge('serve.queue_depth', fleet=self.name).set(depth)
+        if inflight is not None and self.name:
+            gauge('serve.inflight', fleet=self.name).set(inflight)
 
     def __enter__(self):
         return self
@@ -308,7 +357,7 @@ class AnalysisServer(object):
             self._accepting = False
             evicted = list(self._pending)
             self._pending = []
-            gauge('serve.queue_depth').set(0)
+            self._depth_gauge(0)
             for t in evicted:
                 self._finish(t, RequestResult(
                     t.request.request_id, EVICTED,
@@ -323,6 +372,10 @@ class AnalysisServer(object):
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=5.0)
+        # seal the flight recorder: the last N request waterfalls +
+        # metric snapshot land next to the trace for the post-mortem
+        FLIGHT.dump('serve.preempt%s' % ('.' + self.name
+                                         if self.name else ''))
         return {'evicted': len(evicted), 'drained': drained}
 
     # -- submission -------------------------------------------------------
@@ -333,6 +386,24 @@ class AnalysisServer(object):
         rejections resolve immediately."""
         now = time.monotonic()
         counter('serve.submitted').add(1)
+        # trace identity: adopt the caller's ambient context (a Region
+        # dispatching) or mint a fresh one — either way the ticket
+        # carries it across the queue to the worker thread
+        ctx = trace_context()
+        owns_ctx = ctx is None
+        if owns_ctx and current_tracer() is not None:
+            ctx = new_request_context(request.request_id)
+        with trace_scope(ctx if owns_ctx else None), \
+                span('serve.submit', request_id=request.request_id,
+                     algorithm=request.algorithm,
+                     shape_class=request.shape_class) as sp:
+            if owns_ctx and ctx is not None and not ctx.span_id:
+                # this span IS the request's root: every cross-thread
+                # span re-parents to it via ctx.span_id
+                ctx.span_id = sp.span_id
+            return self._submit_traced(request, now, ctx, owns_ctx)
+
+    def _submit_traced(self, request, now, ctx, owns_ctx):
         with self._lock:
             self._submitted += 1
             accepting = self._accepting
@@ -343,29 +414,34 @@ class AnalysisServer(object):
             if preemption_requested():
                 return self._reject_now(request, now, {
                     'code': 'preempted',
-                    'detail': 'server preempted; retry elsewhere'})
+                    'detail': 'server preempted; retry elsewhere'},
+                    ctx=ctx, ctx_owned=owns_ctx)
             return self._reject_now(request, now, {
                 'code': 'shutting_down',
-                'detail': 'server no longer accepting requests'})
+                'detail': 'server no longer accepting requests'},
+                ctx=ctx, ctx_owned=owns_ctx)
         if depth >= self.max_queue:
             return self._reject_now(request, now, {
                 'code': 'queue_full', 'depth': depth,
                 'max_queue': self.max_queue,
-                'detail': 'bounded queue at capacity'})
+                'detail': 'bounded queue at capacity'},
+                ctx=ctx, ctx_owned=owns_ctx)
         decision = admit(request, ndevices=self.ndevices,
                          hbm_bytes=self.hbm_bytes)
         if decision.status == REJECT:
             return self._reject_now(request, now, decision.reason,
-                                    decision=decision)
+                                    decision=decision, ctx=ctx,
+                                    ctx_owned=owns_ctx)
         if decision.options:
             counter('serve.admit_degraded').add(1)
         ticket = None
         with self._cv:
             self._seq += 1
             ticket = _Ticket(request, decision, now, self._seq, aff,
-                             verify=self._should_verify(request))
+                             verify=self._should_verify(request),
+                             ctx=ctx, ctx_owned=owns_ctx)
             self._pending.append(ticket)
-            gauge('serve.queue_depth').set(len(self._pending))
+            self._depth_gauge(len(self._pending))
             self._cv.notify_all()
         return ticket
 
@@ -386,9 +462,11 @@ class AnalysisServer(object):
         h = zlib.crc32(request.request_id.encode('utf-8')) % 10000
         return h < self.verify_fraction * 10000.0
 
-    def _reject_now(self, request, now, reason, decision=None):
+    def _reject_now(self, request, now, reason, decision=None,
+                    ctx=None, ctx_owned=False):
         counter('serve.rejected').add(1)
-        t = _Ticket(request, decision, now, -1, -1)
+        t = _Ticket(request, decision, now, -1, -1, ctx=ctx,
+                    ctx_owned=ctx_owned)
         self._finish(t, RequestResult(
             request.request_id, REJECTED, reason=reason,
             latency_s=time.monotonic() - now,
@@ -411,10 +489,44 @@ class AnalysisServer(object):
             if result.latency_s is not None:
                 histogram('serve.latency_s').observe(result.latency_s)
                 self._latencies.append(result.latency_s)
+            if result.queue_wait_s is not None:
+                self._queue_waits.append(result.queue_wait_s)
+            if result.service_s is not None:
+                self._service_times.append(result.service_s)
         elif result.status == FAILED:
             counter('serve.failed').add(1)
         elif result.status == EVICTED:
             counter('serve.evicted').add(1)
+        # the SLO stream: deadline evictions burn budget, shutdown /
+        # preemption / admission shedding does not (slo.py)
+        if result.status == EVICTED:
+            code = (result.reason or {}).get('code')
+            slo_status = 'deadline_evicted' if code == 'deadline' \
+                else 'cancelled'
+        else:
+            slo_status = result.status
+        self.slo.observe(result.shape_class or 'default',
+                         result.latency_s, slo_status)
+        # terminal trace mark, stamped into the request's own trace
+        # regardless of which thread finishes it
+        tr = current_tracer()
+        if tr is not None and ticket.ctx is not None:
+            tr.event('serve.deliver',
+                     {'request_id': result.request_id,
+                      'status': result.status,
+                      'latency_s': result.latency_s},
+                     ctx=ticket.ctx)
+        if ticket.ctx_owned:
+            # front-door-less serving: this server owns the request's
+            # flight-recorder entry (a Region records its own)
+            FLIGHT.record({
+                'request_id': result.request_id,
+                'trace': ticket.ctx.trace_id if ticket.ctx else None,
+                'status': result.status,
+                'latency_s': result.latency_s,
+                'queue_wait_s': result.queue_wait_s,
+                'service_s': result.service_s,
+                'shape_class': result.shape_class})
         ticket.done.set()
 
     def _evict_expired_locked(self, now):
@@ -510,7 +622,8 @@ class AnalysisServer(object):
                     self._cv.wait(timeout=0.25)
                 group = self._collect_locked(ticket, time.monotonic())
                 self._inflight += 1
-                gauge('serve.queue_depth').set(len(self._pending))
+                self._depth_gauge(len(self._pending),
+                                  inflight=self._inflight)
             try:
                 self._run_group(group, mesh, wi)
             finally:
@@ -611,9 +724,33 @@ class AnalysisServer(object):
             return out
 
         now = time.monotonic()
-        with span('serve.request', request_id=rid,
-                  algorithm=req.algorithm, shape_class=req.shape_class,
-                  batch=len(group), worker=wi):
+        # the queue -> worker thread hop: re-activate the leader's
+        # context (contextvars never reach this long-lived thread) and
+        # retro-emit each member's queue wait into ITS OWN trace, plus
+        # a zero-duration link span tying member traces to the
+        # leader's (the batch runs once, under the leader's identity)
+        tr = current_tracer()
+        if tr is not None:
+            wall = time.time()
+            for t in group:
+                qw = max(now - t.submitted_at, 0.0)
+                if t.ctx is not None:
+                    tr.emit_span('serve.queue.wait', wall - qw, qw,
+                                 {'request_id': t.request.request_id,
+                                  'worker': wi}, ctx=t.ctx)
+            if leader.ctx is not None:
+                for t in group[1:]:
+                    if t.ctx is not None:
+                        tr.emit_span(
+                            'serve.batch.member', wall, 0.0,
+                            {'request_id': t.request.request_id,
+                             'leader_trace': leader.ctx.trace_id,
+                             'leader_request': rid}, ctx=t.ctx)
+        with trace_scope(leader.ctx), \
+                span('serve.request', request_id=rid,
+                     algorithm=req.algorithm,
+                     shape_class=req.shape_class,
+                     batch=len(group), worker=wi):
             try:
                 out = sup.run(work)
             except Exception as e:
@@ -629,7 +766,9 @@ class AnalysisServer(object):
                         admit_options=t.decision.options,
                         batch_size=len(group),
                         algorithm=t.request.algorithm,
-                        shape_class=t.request.shape_class))
+                        shape_class=t.request.shape_class,
+                        queue_wait_s=now - t.submitted_at,
+                        service_s=done_at - now))
                 return
         sup.done(rid)
         if sup.events:
@@ -648,7 +787,9 @@ class AnalysisServer(object):
                 options=opts, admit_options=t.decision.options,
                 batch_size=len(group),
                 algorithm=t.request.algorithm,
-                shape_class=t.request.shape_class))
+                shape_class=t.request.shape_class,
+                queue_wait_s=now - t.submitted_at,
+                service_s=done_at - now))
 
     # -- tier-1 shadow verification ---------------------------------------
 
@@ -678,13 +819,15 @@ class AnalysisServer(object):
         import numpy as np
         from ..resilience.integrity import shadow_margin, violation
         swi = (wi + 1) % len(self.meshes)
-        sprog = self.programs.get(req, self.meshes[swi], swi,
-                                  opts=opts)
-        if sprog.batchable:
-            padded, n = pad_seeds(seeds)
-            ref = sprog.run(padded)[:n]
-        else:
-            ref = sprog.run(seeds)
+        with span('serve.shadow_verify', request_id=req.request_id,
+                  worker=wi, shadow_worker=swi):
+            sprog = self.programs.get(req, self.meshes[swi], swi,
+                                      opts=opts)
+            if sprog.batchable:
+                padded, n = pad_seeds(seeds)
+                ref = sprog.run(padded)[:n]
+            else:
+                ref = sprog.run(seeds)
         margin = shadow_margin(opts)
         counter('serve.shadow.verified').add(1)
         with self._lock:
@@ -750,6 +893,8 @@ class AnalysisServer(object):
         with self._lock:
             results = list(self.results.values())
             lat = list(self._latencies)
+            qwaits = list(self._queue_waits)
+            stimes = list(self._service_times)
             submitted = self._submitted
             queued = len(self._pending)
             inflight = self._inflight
@@ -796,6 +941,19 @@ class AnalysisServer(object):
             'p50_s': self._pctile(lat, 0.50),
             'p99_s': self._pctile(lat, 0.99),
             'mean_s': sum(lat) / len(lat) if lat else None,
+            # the split the combined numbers above conflate: time
+            # queued before a worker picked the ticket vs time
+            # actually executing (queue_wait + service = latency for
+            # unbatched requests; batched members share the service
+            # window, so the split is per-request exact either way)
+            'queue_p50_s': self._pctile(qwaits, 0.50),
+            'queue_p99_s': self._pctile(qwaits, 0.99),
+            'queue_mean_s': sum(qwaits) / len(qwaits)
+            if qwaits else None,
+            'service_p50_s': self._pctile(stimes, 0.50),
+            'service_p99_s': self._pctile(stimes, 0.99),
+            'service_mean_s': sum(stimes) / len(stimes)
+            if stimes else None,
             'rps': completed / wall,
             'wall_s': wall,
             'workers': len(self.meshes),
@@ -825,4 +983,6 @@ class AnalysisServer(object):
                              'p50_s': self._pctile(v, 0.50),
                              'p99_s': self._pctile(v, 0.99)}
                          for k, v in sorted(by_class.items())},
+            # per-shape-class SLO burn verdicts (diagnostics/slo.py)
+            'slo': self.slo.snapshot(),
         }

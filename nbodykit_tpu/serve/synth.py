@@ -164,19 +164,45 @@ def replay_region(region, items, interarrival_s=0.0, seed=0,
     item under its tenant; at a ``{'event': 'join'}`` item call
     ``on_join(region)`` (the caller supplies the arriving fleet —
     ignored when None).  Waits for every verdict; returns the ticket
-    list in submission order."""
+    list in submission order.
+
+    Region delivery is harvest-on-wait, so a concurrent harvester
+    thread waits each ticket as soon as it exists — a verdict is
+    harvested (and its latency clocked) when the fleet finishes, not
+    when the submission loop gets around to it.  With paced arrivals
+    (``interarrival_s > 0``) a tail-end wait loop would otherwise
+    charge the whole remaining replay wall to every early request."""
+    import threading
     import time
     rng = random.Random(seed)
     tickets = []
-    for item in items:
-        if 'event' in item:
-            if item['event'] == 'join' and on_join is not None:
-                on_join(region)
-            continue
-        tickets.append(region.submit(item['request'],
-                                     tenant=item['tenant']))
-        if interarrival_s > 0:
-            time.sleep(rng.expovariate(1.0 / interarrival_s))
-    for t in tickets:
-        region.wait(t)
+    done_submitting = threading.Event()
+
+    def _harvest():
+        i = 0
+        while True:
+            if i < len(tickets):
+                region.wait(tickets[i])
+                i += 1
+            elif done_submitting.is_set():
+                return
+            else:
+                time.sleep(0.005)
+
+    harvester = threading.Thread(target=_harvest, daemon=True,
+                                 name='region-replay-harvest')
+    harvester.start()
+    try:
+        for item in items:
+            if 'event' in item:
+                if item['event'] == 'join' and on_join is not None:
+                    on_join(region)
+                continue
+            tickets.append(region.submit(item['request'],
+                                         tenant=item['tenant']))
+            if interarrival_s > 0:
+                time.sleep(rng.expovariate(1.0 / interarrival_s))
+    finally:
+        done_submitting.set()
+        harvester.join()
     return tickets

@@ -439,6 +439,103 @@ print('fleet gate OK: dead rank detected, mesh re-formed '
       '%(resumed_reps)d' % rec)
 EOF
 
+# observability gate (docs/OBSERVABILITY.md): a 24-request region
+# trace with the live export plane enabled — every request must
+# render a fully linked orphan-free waterfall, the telemetry
+# endpoint must scrape (Prometheus text with real per-fleet labels,
+# SLO snapshot), and an injected preemption must seal the flight
+# recorder next to the trace
+echo "== observability gate (24-req region trace + export + flight) =="
+env NBKIT_DIAGNOSTICS_SYNC=0 NBKIT_TRACE_EXEMPLAR=0.02 \
+    JAX_NUM_CPU_DEVICES=2 python - "$SMOKE_TMP" <<'EOF'
+import json, os, sys, urllib.request
+import nbodykit_tpu
+from nbodykit_tpu.parallel.runtime import cpu_mesh, use_mesh
+from nbodykit_tpu.serve import (AnalysisRequest, AnalysisServer,
+                                QoSPolicy, Region, ResultCache,
+                                ServiceClass)
+from nbodykit_tpu.diagnostics import request_report
+from nbodykit_tpu.diagnostics.analyze import load_processes
+from nbodykit_tpu.diagnostics.export import ensure_exporter, \
+    stop_exporter
+
+tmp = sys.argv[1]
+tracedir = os.path.join(tmp, 'obs_trace')
+os.makedirs(tracedir, exist_ok=True)
+
+
+def req(i, seed, deadline=300.0):
+    return AnalysisRequest(algorithm='FFTPower', nmesh=16, npart=1000,
+                           seed=seed, deadline_s=deadline,
+                           request_id='obs-%03d' % i)
+
+
+def fleet():
+    with use_mesh(cpu_mesh(1)):
+        return AnalysisServer(per_task=1)
+
+
+qos = QoSPolicy(
+    classes=[ServiceClass('interactive'),
+             ServiceClass('bulk', rate=4.0, burst=1)],
+    tenants={'bulk-sweep': 'bulk'}, default_class='interactive')
+with nbodykit_tpu.set_options(diagnostics=tracedir,
+                              telemetry_port=0):
+    region = Region([('a', fleet()), ('b', fleet())],
+                    result_cache=ResultCache(
+                        os.path.join(tmp, 'obs_rcache')), qos=qos)
+    exp = ensure_exporter()
+    assert exp is not None, 'telemetry_port=0 started no exporter'
+    tickets = []
+    # 16 interactive (4 distinct shapes -> warm cache), 4 repeats
+    # (result-cache hits / singleflight), 4 bulk (pacer-held)
+    for i in range(16):
+        tickets.append(region.submit(req(i, seed=100 + i % 4)))
+    for i in range(16, 20):
+        tickets.append(region.submit(req(i, seed=100 + i % 4)))
+    for i in range(20, 24):
+        tickets.append(region.submit(req(i, seed=200 + i),
+                                     tenant='bulk-sweep'))
+    results = [region.wait(t, timeout=300) for t in tickets]
+    assert all(r is not None and r.status == 'completed'
+               for r in results), \
+        [getattr(r, 'status', None) for r in results]
+
+    # scrape the export plane while the region is live
+    text = urllib.request.urlopen(exp.url + '/metrics').read().decode()
+    assert 'region_completed_total' in text, text[:400]
+    assert 'region_fleet_load{fleet=' in text, text[:400]
+    slo = json.loads(urllib.request.urlopen(exp.url + '/slo').read())
+    assert 'region' in slo and slo['region']['verdict'] == 'OK', slo
+    assert urllib.request.urlopen(exp.url + '/healthz').read() \
+        == b'ok\n'
+
+    summary = region.summary()
+    region.shutdown()
+    # injected preemption: the SIGTERM drain path must seal the
+    # flight ring beside the trace
+    region.router.fleets()[0].server.preempt(grace_s=2.0)
+stop_exporter()
+
+procs, torn = load_processes(tracedir)
+assert torn == 0, torn
+rep = request_report(procs)
+assert rep['traces'] >= 24, rep['traces']
+assert rep['complete'] == rep['traces'], rep['incomplete']
+assert rep['orphan_spans'] == 0, rep['orphan_spans']
+assert 'qos_hold' in rep['stage_totals_s'], rep['stage_totals_s']
+
+dumps = [f for f in os.listdir(tracedir) if f.startswith('flight-')]
+assert dumps, 'preemption sealed no flight dump'
+body = json.load(open(os.path.join(tracedir, dumps[0])))
+assert body['reason'].startswith('serve.preempt'), body['reason']
+assert body['requests'], 'flight ring empty'
+print('observability gate OK: %d/%d waterfalls complete, 0 orphans, '
+      'slo %s, flight dump %s (%d entries)'
+      % (rep['complete'], rep['traces'],
+         summary['slo']['verdict'], dumps[0], len(body['requests'])))
+EOF
+
 echo "== tier-1 fast subset =="
 python -m pytest \
     tests/test_diagnostics.py \
@@ -448,6 +545,7 @@ python -m pytest \
     tests/test_tune.py \
     tests/test_serve.py \
     tests/test_region.py \
+    tests/test_observability.py \
     tests/test_lint.py \
     tests/test_lint_dataflow.py \
     tests/test_lint_shardflow.py \

@@ -1,0 +1,540 @@
+"""Tests for the observability plane (ISSUE 17): request-scoped trace
+propagation across every owned thread hop (queue worker, vmap batch
+leader, Supervisor retry, shadow-verify sub-mesh, region pacer,
+singleflight follower), the per-request waterfall reconstruction with
+orphan detection (diagnostics/analyze.py), SLO burn-rate monitoring
+(diagnostics/slo.py), the live export plane — Prometheus text,
+labelled gauges, the HTTP exporter, the flight recorder
+(diagnostics/export.py) — and the regress/doctor ``slo`` posture."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import nbodykit_tpu
+from nbodykit_tpu import _global_options, diagnostics
+from nbodykit_tpu.diagnostics import (REGISTRY, current_tracer,
+                                      new_request_context,
+                                      read_trace, request_report,
+                                      span, trace_context,
+                                      trace_files, trace_scope)
+from nbodykit_tpu.diagnostics.export import (FLIGHT, TelemetryExporter,
+                                             prometheus_text,
+                                             register_source,
+                                             stop_exporter)
+from nbodykit_tpu.diagnostics.metrics import labelled, split_label
+from nbodykit_tpu.diagnostics.slo import SLOTracker
+from nbodykit_tpu.parallel.runtime import cpu_mesh, use_mesh
+from nbodykit_tpu.resilience import reset_faults
+from nbodykit_tpu.serve import (AnalysisRequest, AnalysisServer,
+                                BatchPolicy, QoSPolicy, Region,
+                                ResultCache, ServiceClass)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Registry, faults, options, exporter and flight ring are
+    process-wide; every test sees (and leaves) a pristine copy."""
+    saved = _global_options.copy()
+    REGISTRY.reset()
+    reset_faults()
+    yield
+    stop_exporter()
+    REGISTRY.reset()
+    reset_faults()
+    diagnostics.configure(None)
+    _global_options.clear()
+    _global_options.update(saved)
+
+
+def _one_worker_server(**kw):
+    with use_mesh(cpu_mesh(1)):
+        return AnalysisServer(per_task=1, **kw)
+
+
+def _records(tracedir):
+    out = []
+    for path in trace_files(tracedir):
+        recs, bad = read_trace(path)
+        assert bad == 0
+        out.extend(recs)
+    return out
+
+
+def _report(tracedir):
+    from nbodykit_tpu.diagnostics.analyze import load_processes
+    procs, torn = load_processes(tracedir)
+    assert torn == 0
+    return request_report(procs)
+
+
+def _req(i, seed=None, **kw):
+    kw.setdefault('nmesh', 16)
+    kw.setdefault('npart', 1000)
+    kw.setdefault('deadline_s', 120.0)
+    return AnalysisRequest(seed=seed if seed is not None else 100 + i,
+                           request_id='obs-%03d' % i, **kw)
+
+
+# ---------------------------------------------------------------------------
+# context + labelled metrics primitives
+
+def test_request_context_is_deterministic_and_samplable():
+    a = new_request_context('req-42')
+    b = new_request_context('req-42')
+    c = new_request_context('req-43')
+    assert a.trace_id == b.trace_id and a.trace_id != c.trace_id
+    assert len(a.trace_id) == 16
+    # fraction 0 drops kernel spans for everyone, 1 keeps them all;
+    # the draw is derived from the trace id, so it replays identically
+    assert not new_request_context('req-42', fraction=0.0).sampled
+    assert new_request_context('req-42', fraction=1.0).sampled
+    assert trace_context() is None
+    with trace_scope(a):
+        assert trace_context() is a
+    assert trace_context() is None
+
+
+def test_labelled_metric_names_roundtrip_to_prometheus():
+    assert labelled('serve.queue_depth', {'fleet': 'a'}) \
+        == 'serve.queue_depth{fleet=a}'
+    assert split_label('serve.queue_depth{fleet=a}') \
+        == ('serve.queue_depth', {'fleet': 'a'})
+    assert split_label('plain.name') == ('plain.name', {})
+    from nbodykit_tpu.diagnostics import counter, gauge
+    gauge('serve.queue_depth', fleet='a').set(3)
+    counter('region.route.affinity').add(2)
+    text = prometheus_text()
+    assert 'serve_queue_depth{fleet="a"} 3' in text
+    assert 'region_route_affinity_total 2' in text
+    assert '# TYPE serve_queue_depth gauge' in text
+
+
+def test_cross_thread_span_reparents_to_request_root(tmp_path):
+    """A span opened on a foreign thread under trace_scope lands in
+    the request's trace with ``rpar`` = the root span id — the
+    mechanism every owned thread hop (worker, pacer, batcher,
+    supervisor) rides."""
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        ctx = new_request_context('req-hop')
+        with trace_scope(ctx), span('region.submit',
+                                    request_id='req-hop') as root:
+            ctx.span_id = root.span_id
+
+            def hop():
+                with trace_scope(ctx), span('serve.request',
+                                            request_id='req-hop'):
+                    pass
+            t = threading.Thread(target=hop)
+            t.start()
+            t.join()
+    recs = [r for r in _records(str(tmp_path)) if r['t'] == 'span']
+    by_name = {r['name']: r for r in recs}
+    assert by_name['serve.request']['trace'] == ctx.trace_id
+    assert by_name['serve.request']['rpar'] \
+        == by_name['region.submit']['id']
+    assert 'rpar' not in by_name['region.submit']
+
+
+def test_orphan_and_incomplete_waterfalls_are_detected():
+    """A span whose rpar points at a span id absent from the trace is
+    an orphan, and its trace must NOT count as complete."""
+    good = [
+        {'t': 'span', 'name': 'serve.submit', 'id': 1, 'par': 0,
+         'ts': 1.0, 'dur': 0.1, 'trace': 'aaaa',
+         'attrs': {'request_id': 'g'}},
+        {'t': 'span', 'name': 'serve.request', 'id': 2, 'par': 0,
+         'rpar': 1, 'ts': 1.1, 'dur': 0.5, 'trace': 'aaaa'},
+        {'t': 'span', 'name': 'serve.deliver', 'id': 3, 'par': 0,
+         'rpar': 1, 'ts': 1.6, 'dur': 0.0, 'trace': 'aaaa',
+         'attrs': {'status': 'completed'}},
+    ]
+    orphan = [
+        {'t': 'span', 'name': 'serve.submit', 'id': 4, 'par': 0,
+         'ts': 2.0, 'dur': 0.1, 'trace': 'bbbb',
+         'attrs': {'request_id': 'o'}},
+        {'t': 'span', 'name': 'serve.request', 'id': 5, 'par': 0,
+         'rpar': 999, 'ts': 2.1, 'dur': 0.5, 'trace': 'bbbb'},
+        {'t': 'span', 'name': 'serve.deliver', 'id': 6, 'par': 0,
+         'rpar': 4, 'ts': 2.6, 'dur': 0.0, 'trace': 'bbbb',
+         'attrs': {'status': 'completed'}},
+    ]
+    rep = request_report({7: good + orphan})
+    assert rep['traces'] == 2
+    assert rep['complete'] == 1
+    assert rep['orphan_spans'] == 1
+    assert rep['incomplete'] == ['bbbb']
+
+
+# ---------------------------------------------------------------------------
+# serve-layer propagation
+
+def test_serve_waterfall_queue_service_split_and_slo(tmp_path):
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        with _one_worker_server(
+                batch=BatchPolicy(max_delay_s=0)) as srv:
+            results = [srv.wait(srv.submit(_req(i)), timeout=180)
+                       for i in range(3)]
+            summary = srv.summary()
+    assert [r.status for r in results] == ['completed'] * 3
+    # the split rides each result AND the summary (old combined
+    # fields stay)
+    for r in results:
+        assert r.queue_wait_s is not None and r.service_s is not None
+        assert r.latency_s >= r.service_s
+    assert summary['queue_p99_s'] is not None
+    assert summary['service_p99_s'] is not None
+    assert summary['p99_s'] is not None
+    assert summary['slo']['verdict'] == 'OK'
+    assert summary['slo']['classes']  # keyed by shape class
+    rep = _report(str(tmp_path))
+    assert rep['traces'] == 3
+    assert rep['complete'] == 3 and rep['orphan_spans'] == 0
+    stages = rep['stage_totals_s']
+    assert 'queue' in stages and 'service' in stages
+
+
+def test_batched_group_members_link_to_leader_trace(tmp_path):
+    """vmap-batched followers get a zero-duration link span tying
+    their trace to the leader's — no request vanishes into a batch."""
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        with _one_worker_server(
+                batch=BatchPolicy(max_batch=4,
+                                  max_delay_s=0.25)) as srv:
+            blocker = srv.submit(_req(0, seed=5))
+            tickets = [srv.submit(_req(i, seed=5))
+                       for i in range(1, 4)]
+            results = [srv.wait(t, timeout=180)
+                       for t in [blocker] + tickets]
+    assert all(r.status == 'completed' for r in results)
+    assert any(r.batch_size > 1 for r in results)
+    recs = _records(str(tmp_path))
+    links = [r for r in recs if r.get('name') == 'serve.batch.member']
+    assert links, 'no batch link spans emitted'
+    traces = {r['trace'] for r in recs if r.get('trace')}
+    for link in links:
+        assert link['attrs']['leader_trace'] in traces
+        assert link['trace'] != link['attrs']['leader_trace']
+    rep = _report(str(tmp_path))
+    assert rep['complete'] == rep['traces'] \
+        and rep['orphan_spans'] == 0
+
+
+def test_supervisor_retry_lands_in_request_trace(tmp_path):
+    from nbodykit_tpu.resilience import RetryPolicy
+    with nbodykit_tpu.set_options(
+            diagnostics=str(tmp_path),
+            faults='serve.request.attempt@2:unavailable'):
+        reset_faults()
+        with _one_worker_server(
+                batch=BatchPolicy(max_delay_s=0),
+                retry=RetryPolicy(max_retries=3,
+                                  base_s=0.01)) as srv:
+            results = [srv.wait(srv.submit(_req(i, nmesh=32,
+                                                npart=20000)),
+                                timeout=180) for i in range(3)]
+    assert all(r.status == 'completed' for r in results)
+    faulted = [r for r in results if r.event_count('retries')]
+    assert len(faulted) == 1
+    recs = _records(str(tmp_path))
+    retry = [r for r in recs if r.get('name') == 'resilience.retry']
+    assert retry, 'retry event did not land in the trace'
+    # the retry is attributed to exactly the faulted request's trace
+    req_root = [r for r in recs if r.get('name') == 'serve.submit'
+                and (r.get('attrs') or {}).get('request_id')
+                == faulted[0].request_id and r['t'] == 'span']
+    assert retry[0]['trace'] == req_root[0]['trace']
+    rep = _report(str(tmp_path))
+    assert rep['complete'] == rep['traces'] \
+        and rep['orphan_spans'] == 0
+
+
+def test_shadow_verify_span_rides_request_trace(tmp_path):
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        with _one_worker_server(
+                batch=BatchPolicy(max_delay_s=0),
+                verify_fraction=1.0) as srv:
+            r = srv.wait(srv.submit(_req(0)), timeout=180)
+    assert r.status == 'completed'
+    recs = _records(str(tmp_path))
+    ver = [x for x in recs if x.get('name') == 'serve.shadow_verify'
+           and x['t'] == 'span']
+    assert ver, 'no shadow-verify span'
+    root = [x for x in recs if x.get('name') == 'serve.submit'
+            and x['t'] == 'span']
+    assert ver[0]['trace'] == root[0]['trace']
+    rep = _report(str(tmp_path))
+    assert rep['complete'] == rep['traces'] \
+        and rep['orphan_spans'] == 0
+    assert 'verify' in rep['stage_totals_s']
+
+
+# ---------------------------------------------------------------------------
+# region-layer propagation
+
+def _region(tmp, fleets=1, qos=None, cache=True):
+    return Region(
+        [('f%d' % i, _one_worker_server()) for i in range(fleets)],
+        result_cache=ResultCache(os.path.join(tmp, 'rcache'))
+        if cache else None,
+        qos=qos)
+
+
+def test_region_pacer_hold_span_propagates(tmp_path):
+    """A ticket held by the fair-share pacer and dispatched from the
+    pacer thread still renders one linked waterfall, with the hold
+    visible as a ``region.qos.hold`` stage."""
+    qos = QoSPolicy(
+        classes=[ServiceClass('interactive'),
+                 ServiceClass('bulk', rate=4.0, burst=1)],
+        tenants={'sweep': 'bulk'}, default_class='interactive')
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        region = _region(str(tmp_path), qos=qos)
+        t1 = region.submit(_req(0, seed=1), tenant='sweep')
+        t2 = region.submit(_req(1, seed=2), tenant='sweep')
+        r1 = region.wait(t1, timeout=180)
+        r2 = region.wait(t2, timeout=180)
+        region.shutdown()
+    assert r1.status == 'completed' and r2.status == 'completed'
+    recs = _records(str(tmp_path))
+    holds = [x for x in recs if x.get('name') == 'region.qos.hold']
+    assert holds, 'held ticket emitted no qos.hold span'
+    roots = {x['trace']: x for x in recs
+             if x.get('name') == 'region.submit' and x['t'] == 'span'}
+    assert holds[0]['trace'] in roots
+    assert holds[0]['rpar'] == roots[holds[0]['trace']]['id']
+    rep = _report(str(tmp_path))
+    assert rep['complete'] == rep['traces'] \
+        and rep['orphan_spans'] == 0
+    assert 'qos_hold' in rep['stage_totals_s']
+
+
+def test_region_singleflight_follower_links_and_cache_spans(tmp_path):
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        region = _region(str(tmp_path))
+        lead = region.submit(_req(0, seed=9))
+        follow = region.submit(_req(1, seed=9))
+        r1 = region.wait(lead, timeout=180)
+        r2 = region.wait(follow, timeout=180)
+        # a later identical request is a result-cache hit
+        hit = region.submit(_req(2, seed=9))
+        r3 = region.wait(hit, timeout=60)
+        summary = region.summary()
+        region.shutdown()
+    assert all(r.status == 'completed' for r in (r1, r2, r3))
+    assert summary['routed'].get('follower', 0) >= 1
+    assert summary['routed'].get('result_cache', 0) >= 1
+    recs = _records(str(tmp_path))
+    by_name = {}
+    for r in recs:
+        by_name.setdefault(r.get('name'), []).append(r)
+    links = by_name.get('region.singleflight.follower')
+    assert links, 'no follower link span'
+    lead_root = [x for x in by_name['region.submit']
+                 if x['t'] == 'span' and (x.get('attrs') or {})
+                 .get('request_id') == r1.request_id][0]
+    assert links[0]['attrs']['leader_trace'] == lead_root['trace']
+    assert by_name.get('region.cache.commit'), 'no commit span'
+    assert by_name.get('region.cache.hit'), 'no cache-hit span'
+    rep = _report(str(tmp_path))
+    assert rep['complete'] == rep['traces'] \
+        and rep['orphan_spans'] == 0
+
+
+def test_region_slo_and_flight_record_terminal_verdicts(tmp_path):
+    qos = QoSPolicy(
+        classes=[ServiceClass('interactive'),
+                 ServiceClass('bulk', rate=1.0, burst=1)],
+        tenants={'sweep': 'bulk'}, default_class='interactive')
+    n0 = len(FLIGHT)
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        region = _region(str(tmp_path), qos=qos)
+        ok = region.wait(region.submit(_req(0, seed=3)), timeout=180)
+        # warm consumes the burst token so the tight-deadline pair
+        # below cannot slip through and die a (burning) deadline death
+        warm = region.submit(_req(1, seed=4), tenant='sweep')
+        # due-time past the deadline -> qos_throttled eviction, which
+        # must shed (never burn the availability budget)
+        t1 = region.submit(_req(2, seed=5, deadline_s=0.05),
+                           tenant='sweep')
+        t2 = region.submit(_req(3, seed=6, deadline_s=0.05),
+                           tenant='sweep')
+        shed = [region.wait(t1, timeout=60),
+                region.wait(t2, timeout=60)]
+        warm_r = region.wait(warm, timeout=180)
+        summary = region.summary()
+        region.shutdown()
+    assert ok.status == 'completed' and warm_r.status == 'completed'
+    assert all(r.status == 'evicted'
+               and r.reason['code'] == 'qos_throttled' for r in shed)
+    slo = summary['slo']
+    assert slo['verdict'] == 'OK'   # shedding is not failure
+    bulk = slo['classes']['bulk']
+    assert bulk['shed'] == 2 and bulk['bad'] == 0
+    # the region (context owner) recorded every terminal verdict
+    entries = FLIGHT.snapshot()[n0:]
+    mine = [e for e in entries
+            if (e.get('request_id') or '').startswith('obs-')]
+    assert len(mine) >= 4
+    assert {e['layer'] for e in mine} == {'region'}
+
+
+# ---------------------------------------------------------------------------
+# SLO burn math
+
+def test_slo_burn_windows_and_verdicts():
+    t0 = 1000.0
+    tr = SLOTracker()
+    for i in range(100):
+        tr.observe('interactive', latency_s=0.1, t=t0 + i)
+    assert tr.verdict() == 'OK'
+    # 1 failure in 101 at three-nines: burn ~10 -> slow-window WARN,
+    # under the 14.4 fast-page bar
+    tr.observe('interactive', status='failed', t=t0 + 100)
+    assert tr.verdict() == 'WARN'
+    # 5 failures: burn ~47 -> fast-window FAIL
+    for i in range(4):
+        tr.observe('interactive', status='failed', t=t0 + 101 + i)
+    snap = tr.snapshot()
+    assert snap['verdict'] == 'FAIL'
+    w = snap['classes']['interactive']['windows']
+    assert w['fast']['burn'] >= 14.4
+
+    # load shedding never burns
+    tr2 = SLOTracker()
+    tr2.observe('bulk', latency_s=0.1, t=t0)
+    for i in range(50):
+        tr2.observe('bulk', status='qos_throttled', t=t0 + i)
+        tr2.observe('bulk', status='rejected', t=t0 + i)
+    assert tr2.verdict() == 'OK'
+    assert tr2.snapshot()['classes']['bulk']['shed'] == 100
+
+    # latency over threshold burns the latency budget
+    tr3 = SLOTracker()
+    for i in range(50):
+        tr3.observe('interactive', latency_s=31.0, t=t0 + i)
+    assert tr3.verdict() == 'FAIL'
+
+
+# ---------------------------------------------------------------------------
+# export plane
+
+def test_exporter_serves_metrics_slo_flight_and_health():
+    from nbodykit_tpu.diagnostics import counter, gauge
+    counter('serve.completed').add(7)
+    gauge('serve.queue_depth', fleet='x').set(2)
+    tr = SLOTracker()
+    tr.observe('interactive', latency_s=0.2)
+    register_source('test', tr.snapshot)
+    FLIGHT.record({'request_id': 'exp-1', 'status': 'completed'})
+    exp = TelemetryExporter(port=0)
+    try:
+        base = exp.url
+        text = urllib.request.urlopen(base + '/metrics').read().decode()
+        assert 'serve_completed_total 7' in text
+        assert 'serve_queue_depth{fleet="x"} 2' in text
+        health = urllib.request.urlopen(base + '/healthz').read()
+        assert health == b'ok\n'
+        slo = json.loads(urllib.request.urlopen(base + '/slo').read())
+        assert slo['test']['classes']['interactive']['total'] == 1
+        raw = json.loads(
+            urllib.request.urlopen(base + '/metrics.json').read())
+        assert raw['serve.completed']['value'] == 7
+        fl = json.loads(
+            urllib.request.urlopen(base + '/flight').read())
+        assert any(e.get('request_id') == 'exp-1'
+                   for e in fl['requests'])
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + '/nope')
+    finally:
+        exp.stop()
+
+
+def test_exporter_option_singleton(tmp_path):
+    from nbodykit_tpu.diagnostics.export import ensure_exporter
+    assert ensure_exporter() is None    # option unset -> disabled
+    with nbodykit_tpu.set_options(telemetry_port=0):
+        exp = ensure_exporter()
+        assert exp is not None and exp.port > 0
+        assert ensure_exporter() is exp     # idempotent singleton
+        out = urllib.request.urlopen(exp.url + '/healthz').read()
+        assert out == b'ok\n'
+    stop_exporter()
+
+
+def test_flight_dump_on_preemption(tmp_path):
+    """Preempting a server seals the flight ring next to the trace —
+    the post-mortem artifact the smoke gate asserts on."""
+    with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
+        with _one_worker_server(
+                batch=BatchPolicy(max_delay_s=0)) as srv:
+            r = srv.wait(srv.submit(_req(0)), timeout=180)
+            assert r.status == 'completed'
+            srv.preempt()
+    dumps = [f for f in os.listdir(str(tmp_path))
+             if f.startswith('flight-') and f.endswith('.json')]
+    assert dumps, 'preempt sealed no flight dump'
+    body = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    assert body['reason'].startswith('serve.preempt')
+    assert any(e.get('request_id') == r.request_id
+               for e in body['requests'])
+    assert 'metrics' in body and 'sources' in body
+
+
+# ---------------------------------------------------------------------------
+# regress / doctor posture
+
+def test_slo_summary_reads_round_and_doctor_renders_verdict(tmp_path):
+    from nbodykit_tpu.diagnostics.regress import (build_history,
+                                                  render_regress,
+                                                  slo_summary)
+    rec = {'metric': 'regiontrace_n24', 'unit': 's', 'value': 0.4,
+           'requests': 24, 'lost': 0,
+           'slo': {'verdict': 'OK', 'classes': {
+               'interactive': {'verdict': 'OK', 'total': 20,
+                               'shed': 0, 'bad': 0, 'p99_s': 0.4,
+                               'windows': {
+                                   'fast': {'burn': 0.0},
+                                   'slow': {'burn': 0.0}}}}},
+           'waterfalls': {'traces': 24, 'complete': 24,
+                          'complete_fraction': 1.0,
+                          'orphan_spans': 0},
+           'trace_overhead': {'n': 24, 'overhead': 0.012,
+                              'wall_on_s': 2.0, 'wall_off_s': 1.98},
+           'measured_at': '2026-08-06T00:00:00Z'}
+    (tmp_path / 'BENCH_r01.json').write_text(json.dumps(
+        {'cmd': 'bench --region-trace 24', 'parsed': rec}))
+    slo = slo_summary(str(tmp_path))
+    assert slo['verdict'] == 'OK'
+    assert slo['complete'] == 24 and slo['orphan_spans'] == 0
+    assert slo['overhead'] == 0.012
+    assert slo['classes']['interactive']['fast_burn'] == 0.0
+    history = build_history(str(tmp_path), write=False)
+    text = render_regress(history)
+    line = next(l for l in text.splitlines()
+                if l.strip().startswith('slo:'))
+    assert '24/24 waterfall(s) complete' in line
+    assert 'overhead 1.2%' in line
+
+    import io
+    from nbodykit_tpu.diagnostics.__main__ import run_doctor
+    out = io.StringIO()
+    run_doctor(root=str(tmp_path), out=out)
+    text = out.getvalue()
+    line = next(l for l in text.splitlines() if l.startswith('slo '))
+    assert 'OK' in line
+
+    # an over-budget overhead or a burning fast window must FAIL
+    rec2 = dict(rec, trace_overhead={'n': 24, 'overhead': 0.09,
+                                     'wall_on_s': 2, 'wall_off_s': 1})
+    (tmp_path / 'BENCH_r02.json').write_text(json.dumps(
+        {'cmd': 'bench', 'parsed': rec2}))
+    out = io.StringIO()
+    rc = run_doctor(root=str(tmp_path), out=out)
+    assert rc == 1
+    assert 'slo          FAIL' in out.getvalue()
