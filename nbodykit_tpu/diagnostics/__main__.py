@@ -295,9 +295,12 @@ def run_doctor(trace=None, root='.', self_check_only=False,
     whose jit label carries an open NBK2xx finding (the
     static/runtime cross-link), device live-byte watermarks past half
     a v5e's HBM while open NBK5xx (donation/peak) findings exist (the
-    same cross-link for memory), and tune-cache entries measured on a
-    different platform/device kind than this host or older than 30
-    days — loud, but not blocking.
+    same cross-link for memory), open NBK801/NBK803 host-concurrency
+    findings printed next to hung-collective / silent-process trace
+    evidence (the same cross-link for the threaded control plane),
+    and tune-cache entries measured on a different platform/device
+    kind than this host or older than 30 days — loud, but not
+    blocking.
     """
     out = out if out is not None else sys.stdout
     lines, fail, warn = [], [], []
@@ -314,6 +317,8 @@ def run_doctor(trace=None, root='.', self_check_only=False,
         trace = None
         root = None
 
+    hung, silent = [], []     # runtime evidence the concurrency
+    # cross-link below pairs with open NBK801/NBK803 findings
     if trace and os.path.exists(trace):
         from .analyze import analyze
         try:
@@ -428,6 +433,39 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                 lines.append('lint         OK: 0 new findings '
                              '(%d grandfathered in lint_baseline.json)'
                              % ngrand)
+            # static/runtime cross-link #3 — the host-concurrency
+            # form of the NBK2xx<->compile pattern: an open NBK801
+            # (lock-order inversion) or NBK803 (blocking under a
+            # lock) finding is the static shape of a wedge, and a
+            # trace showing hung collectives or silent processes is
+            # the same wedge observed at runtime — print them on one
+            # line so the pairing is unmissable
+            open_nbk8 = [f for f in open_findings
+                         if f.code in ('NBK801', 'NBK803')]
+            if open_nbk8:
+                warn.append('concurrency')
+                f0 = open_nbk8[0]
+                evidence = ''
+                if hung or silent:
+                    bits = []
+                    if hung:
+                        bits.append('%d hung collective(s) (e.g. %r)'
+                                    % (len(hung),
+                                       hung[0].get('name', '?')))
+                    if silent:
+                        bits.append('%d silent process(es)'
+                                    % len(silent))
+                    evidence = ('; runtime evidence in the trace: %s'
+                                % '; '.join(bits))
+                lines.append('concurrency  WARN: %d open '
+                             'NBK801/NBK803 finding(s) — e.g. %s at '
+                             '%s:%d: %s%s'
+                             % (len(open_nbk8), f0.code, f0.path,
+                                f0.line, f0.message, evidence))
+            else:
+                lines.append('concurrency  OK: 0 open NBK8xx '
+                             'findings (lock order + '
+                             'blocking-under-lock statically clean)')
         # static/runtime cross-link: a jit label that missed the
         # compile cache AND sits in a file with an open NBK2xx finding
         # is almost certainly the finding biting at runtime

@@ -292,8 +292,22 @@ class TelemetryExporter(object):
         self._thread.start()
 
     def stop(self):
+        # shutdown() only *requests* serve_forever to exit; without
+        # the join an immediate successor exporter can race this one
+        # for the port, and a stop_exporter()/ensure_exporter() pair
+        # in a loop flakes with address-in-use.  The join makes stop
+        # a contract: when it returns, the serving thread is gone.
+        # Bounded join: serve_forever polls at 0.5s, so 5s is ample,
+        # and a wedged scrape must not hang interpreter exit.
         try:
             self._httpd.shutdown()
+        except Exception:       # pragma: no cover - double stop
+            pass
+        t = self._thread
+        if t is not None and t.is_alive() and \
+                t is not threading.current_thread():
+            t.join(timeout=5.0)
+        try:
             self._httpd.server_close()
         except Exception:       # pragma: no cover - double stop
             pass

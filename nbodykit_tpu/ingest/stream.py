@@ -253,17 +253,23 @@ class _HostMeter(object):
     most two chunks."""
 
     def __init__(self):
+        import threading
+        self._lock = threading.Lock()
         self.live = 0
         self.peak = 0
 
     def add(self, nbytes):
-        self.live += int(nbytes)
-        self.peak = max(self.peak, self.live)
-        gauge('ingest.host_bytes').set(self.live)
+        with self._lock:
+            self.live += int(nbytes)
+            self.peak = max(self.peak, self.live)
+            live = self.live
+        gauge('ingest.host_bytes').set(live)
 
     def drop(self, nbytes):
-        self.live -= int(nbytes)
-        gauge('ingest.host_bytes').set(self.live)
+        with self._lock:
+            self.live -= int(nbytes)
+            live = self.live
+        gauge('ingest.host_bytes').set(live)
 
 
 def _put_chunk(chunk, cols, shard_fns, ndev, pos_dtype):

@@ -16,15 +16,19 @@ Since v2 the linter is **interprocedural**: ``callgraph.py`` builds a
 project-wide call graph (cross-module, resolving the ``jax.jit`` /
 ``instrumented_jit`` / ``shard_map`` / ``lru_cache``-builder wrapper
 idioms, including the lru-cached program-tuple unpacking in dfft.py),
-and three analysis families run on it — ``collectives.py`` enumerates
+and four analysis families run on it — ``collectives.py`` enumerates
 per-path collective sequences (NBK103 deadlock detection),
 ``sizes.py`` tracks full-mesh-sized values through assignments and
 call boundaries with a donation-aware symbolic peak model (NBK5xx,
-``--memory-report``), and ``shardflow.py``/``dtypeflow.py`` run
+``--memory-report``), ``shardflow.py``/``dtypeflow.py`` run
 abstract interpretation over a joint (sharding x dtype) lattice —
 PartitionSpec facts across shard_map/jit boundaries (NBK6xx,
 ``--shard-report``) and dtype-width facts through casts, allocators
-and return summaries (NBK7xx).
+and return summaries (NBK7xx) — and ``concurrency.py`` models the
+host-side threaded control plane: lock identities with per-function
+held-sets spliced through call sites, plus a thread-entry model
+tagging every function with the roots that reach it (NBK8xx,
+``--lock-report``/``--threads-report``).
 
 Rule families (full catalog: ``nbodykit-tpu-lint --list-rules``,
 docs/LINT.md):
@@ -50,6 +54,10 @@ NBK7xx   precision-flow — narrow collective payloads consumed raw,
          bf16 accumulation without compensated summation,
          mesh-promoting mixed-dtype arithmetic, value-range-proved
          int32 index overflow (the NBK302 upgrade)
+NBK8xx   host-concurrency — lock-order inversions, shared-state
+         races across thread roots, blocking calls (and JAX
+         collectives) under held locks, unreleased-on-exception
+         acquires, thread spawns that drop the trace context
 =======  ==========================================================
 
 Workflow: ``nbodykit-tpu-lint --baseline lint_baseline.json`` exits
@@ -77,5 +85,9 @@ from .report import (family_of, family_stats,  # noqa: F401
                      render_summary, summarize_findings)
 from .shardflow import (shard_report,  # noqa: F401
                         render_shard_report)
-from .cli import (main, run_lint, run_memory_report,  # noqa: F401
-                  run_shard_report)
+from .concurrency import (lock_report,  # noqa: F401
+                          render_lock_report, render_threads_report,
+                          threads_report)
+from .cli import (main, run_lint, run_lock_report,  # noqa: F401
+                  run_memory_report, run_shard_report,
+                  run_threads_report)

@@ -9,6 +9,9 @@
     nbodykit-tpu-lint --memory-report --nmesh 1024 bench.py
     nbodykit-tpu-lint --nmesh 1024 --hbm-gb 16    # NBK503 gating
     nbodykit-tpu-lint --shard-report nbodykit_tpu/
+    nbodykit-tpu-lint --lock-report nbodykit_tpu/
+    nbodykit-tpu-lint --threads-report nbodykit_tpu/
+    nbodykit-tpu-lint --select NBK8             # host-concurrency
     nbodykit-tpu-lint --explain NBK601
 
 Exit codes: 0 — no non-baselined findings; 1 — new findings (the CI
@@ -103,6 +106,35 @@ def run_shard_report(paths, out=None):
     return report
 
 
+def run_lock_report(paths, out=None):
+    """--lock-report: every lock identity with its construction
+    site, acquiring thread roots, max held-set and the blocking
+    calls issued while it is held."""
+    from .concurrency import lock_report, render_lock_report
+    out = out if out is not None else sys.stdout
+    project, parse_findings = build_project(paths)
+    for f in parse_findings:
+        print('nbodykit-tpu-lint: %s: %s' % (f.path, f.message),
+              file=sys.stderr)
+    report = lock_report(project)
+    out.write(render_lock_report(report))
+    return report
+
+
+def run_threads_report(paths, out=None):
+    """--threads-report: every thread root with its spawn site and
+    the functions it reaches."""
+    from .concurrency import threads_report, render_threads_report
+    out = out if out is not None else sys.stdout
+    project, parse_findings = build_project(paths)
+    for f in parse_findings:
+        print('nbodykit-tpu-lint: %s: %s' % (f.path, f.message),
+              file=sys.stderr)
+    report = threads_report(project)
+    out.write(render_threads_report(report))
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='nbodykit-tpu-lint',
@@ -137,6 +169,14 @@ def main(argv=None):
     ap.add_argument('--shard-report', action='store_true',
                     help='print the shard_map boundary table (mesh '
                          'axes, in/out specs) instead of linting')
+    ap.add_argument('--lock-report', action='store_true',
+                    help='print the host-concurrency lock table '
+                         '(identity, acquiring threads, max '
+                         'held-set, blocking calls under it) '
+                         'instead of linting')
+    ap.add_argument('--threads-report', action='store_true',
+                    help='print the thread-root table (spawn site, '
+                         'reachable functions) instead of linting')
     ap.add_argument('--memory-report', action='store_true',
                     help='print the per-function symbolic peak table '
                          'for the declared config (requires --nmesh) '
@@ -181,6 +221,14 @@ def main(argv=None):
 
     if args.shard_report:
         run_shard_report(paths)
+        return 0
+
+    if args.lock_report:
+        run_lock_report(paths)
+        return 0
+
+    if args.threads_report:
+        run_threads_report(paths)
         return 0
 
     config = _memory_config_from(args)

@@ -1,0 +1,1218 @@
+"""Host-concurrency analysis: the NBK8xx engine.
+
+PRs 9-17 grew a second program the traced-code analyses cannot see: a
+host-side threaded control plane (server worker threads, the region
+router + QoS pacer, the replay harvester, the exporter's
+ThreadingHTTPServer, the fleet monitor, the heartbeat writer).  Its
+failure modes are the classic ones — deadlock by lock-order inversion,
+data races on shared mutable state, a fleet-wide wedge from a blocking
+call (or a JAX collective) issued while holding a lock — and none of
+them are visible to the shard/dtype/collective analyses, which only
+model traced code.
+
+This module is the static model of that plane, built on the same
+:class:`~nbodykit_tpu.lint.callgraph.Project` graph the other
+interprocedural families use:
+
+**Lock model** — ``threading.Lock/RLock/Condition/Semaphore``
+construction sites become lock *identities*: ``mod.Class.attr`` for
+``self.attr = threading.Lock()`` (the dtypeflow ClassDef climb finds
+the owner), ``mod.name`` for module globals.  A
+``threading.Condition(self._lock)`` aliases the lock it wraps — the
+``_lock``/``_cv`` pairing the serve plane uses everywhere — so
+acquiring the condition IS acquiring the lock.  ``with lock:`` /
+``acquire()``/``release()`` build per-function *held-set* facts, and a
+must-hold entry summary is spliced through call sites to fixpoint
+(the intersection over all call sites, so ``*_locked`` helpers called
+under the lock are known to hold it).
+
+**Thread model** — ``threading.Thread(target=...)`` / ``Timer``,
+``BaseHTTPRequestHandler`` subclasses' ``do_*`` methods
+(``ThreadingHTTPServer`` spawns one thread per request), ``atexit``
+and ``signal`` handlers are roots; every function is tagged with the
+set of roots that can reach it over the call graph.
+
+Rules built on the two models (registered in rules.py):
+
+=======  ==========================================================
+NBK801   lock-order inversion: two locks acquired in opposite orders
+         on any two interprocedural paths — the static deadlock, the
+         host-side sibling of NBK103
+NBK802   shared mutable state: a self/module attribute written from
+         two or more thread roots with no common lock held at every
+         write — the static race
+NBK803   blocking call while holding a lock: queue get/put without a
+         timeout, ``join()``/``wait()`` without a timeout, socket /
+         HTTP / subprocess, and any call whose summary reaches a JAX
+         collective (the "collective under a lock" fleet wedge)
+NBK804   ``acquire()`` not released on exception: no ``with``, no
+         try/finally release
+NBK805   a thread spawn that drops the trace context: the target
+         reaches ``span(...)`` emission but no ``trace_scope``
+         propagation wraps the hop (the static form of PR 17's
+         orphaned-waterfall FAIL)
+=======  ==========================================================
+
+``--lock-report`` renders every lock identity with its construction
+site, acquiring thread roots, maximum held-set and the blocking calls
+issued under it; ``--threads-report`` renders every thread root with
+the functions it reaches.  Stdlib-only, pure AST, like the rest of
+the package.
+"""
+
+import ast
+import collections
+
+# -- recognized constructors ------------------------------------------------
+
+_LOCK_KINDS = {
+    'Lock': 'lock', 'RLock': 'rlock', 'Condition': 'condition',
+    'Semaphore': 'semaphore', 'BoundedSemaphore': 'semaphore',
+}
+_QUEUE_TAILS = frozenset({
+    'Queue', 'LifoQueue', 'PriorityQueue', 'SimpleQueue'})
+_SPAWN_TAILS = frozenset({'Thread', 'Timer'})
+_HANDLER_BASES = frozenset({
+    'BaseHTTPRequestHandler', 'SimpleHTTPRequestHandler',
+    'StreamRequestHandler', 'DatagramRequestHandler',
+    'BaseRequestHandler'})
+
+# tails that block on the network / a child process regardless of the
+# receiver (no project def shadows these names)
+_NET_BLOCK_TAILS = frozenset({
+    'urlopen', 'accept', 'recv', 'recvfrom', 'sendall', 'connect',
+    'getresponse', 'communicate', 'serve_forever'})
+_SUBPROCESS_TAILS = frozenset({
+    'run', 'call', 'check_call', 'check_output'})
+
+# method names too generic for the unique-tail fallback: they collide
+# with stdlib objects (Event.set, Thread.start, dict.get ...) and a
+# false edge there would poison the held-set splice
+_FALLBACK_BLOCKLIST = frozenset({
+    'start', 'set', 'get', 'put', 'join', 'wait', 'clear', 'close',
+    'run', 'stop', 'add', 'update', 'pop', 'append', 'remove',
+    'items', 'keys', 'values', 'read', 'write', 'open', 'send',
+    'recv', 'acquire', 'release', 'notify', 'notify_all', 'cancel',
+    'done', 'result', 'submit', 'load', 'dump', 'dumps', 'loads',
+    'name', 'copy', 'register', 'record', 'flush', 'strip', 'split',
+    'sort', 'index', 'count', 'insert', 'extend', 'reverse', 'find',
+    'replace', 'format', 'encode', 'decode', 'lower', 'upper',
+    'seek', 'tell', 'readline', 'readlines', 'writelines', 'mkdir',
+    'exists', 'discard', 'setdefault', 'popleft', 'appendleft'})
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+_MAX_PASSES = 20
+_MAX_BLOCK_SUMMARY = 8
+
+#: one acquisition event: the held-set at the acquire, the lock
+#: acquired, and the AST node (for witnesses)
+Acquire = collections.namedtuple('Acquire', ['held', 'lock', 'node'])
+#: one blocking event under a (possibly empty) held-set
+Blocking = collections.namedtuple(
+    'Blocking', ['held', 'kind', 'detail', 'node'])
+#: one shared-state write: the state identity, lexical held-set, node
+Write = collections.namedtuple('Write', ['state', 'held', 'node'])
+#: one resolved call edge: callee function id, lexical held-set, node
+Edge = collections.namedtuple('Edge', ['callee', 'held', 'node'])
+#: one thread spawn site: the root label, resolved target fn id (or
+#: None), and the construction node
+Spawn = collections.namedtuple('Spawn', ['label', 'target', 'node'])
+
+
+def _is_threading_call(q, tails):
+    """True when dotted name ``q`` is ``threading.<tail>`` (or the
+    bare tail from ``from threading import Lock``-style aliasing that
+    scopes.py already expanded)."""
+    if q is None:
+        return False
+    head, _, tail = q.rpartition('.')
+    return tail in tails and head.rsplit('.', 1)[-1] in (
+        'threading', 'queue') if head else tail in tails
+
+
+def _enclosing_class(ctx, fn):
+    """The ClassDef a method belongs to, or None (climbs parents —
+    ClassDef is not a scope node, so scope_chain skips it).  The
+    dtypeflow idiom."""
+    n = ctx.parents.get(fn)
+    while n is not None:
+        if isinstance(n, ast.ClassDef):
+            return n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Module)):
+            return None
+        n = ctx.parents.get(n)
+    return None
+
+
+class _Analysis(object):
+    """All five NBK8xx analyses over one Project, built once and
+    cached on the project instance (``analysis_for``)."""
+
+    def __init__(self, project):
+        self.project = project
+        # -- lock model --
+        self.locks = {}          # ident -> {'kind','ctx','node'}
+        self.alias = {}          # condition ident -> wrapped ident
+        self.local_locks = {}    # (fn id, name) -> ident
+        self.queues = set()      # instance idents built as queue.*
+        # -- class model --
+        self.classes = {}        # 'mod.Class' -> {'ctx','node','methods'}
+        self.fn_class = {}       # fn id -> 'mod.Class'
+        self.instance_class = {}  # 'mod.Class.attr'/'mod.name' -> class
+        self.method_tails = collections.defaultdict(list)
+        # -- thread model --
+        self.spawns = []         # [(ctx, fn_id_or_None, Spawn)]
+        self.threads = collections.defaultdict(set)   # fn id -> roots
+        self.root_info = {}      # label -> {'ctx','node','kind','target'}
+        # -- per-function lexical facts --
+        self.acquires = collections.defaultdict(list)  # fn id -> [Acquire]
+        self.blocking = collections.defaultdict(list)  # fn id -> [Blocking]
+        self.writes = collections.defaultdict(list)    # fn id -> [Write]
+        self.edges = collections.defaultdict(list)     # fn id -> [Edge]
+        self.bare_acquires = collections.defaultdict(list)
+        self.has_collective = set()   # fn ids with a lexical collective
+        self.has_span = set()         # fn ids calling span(...)
+        self.has_scope = set()        # fn ids calling trace_scope(...)
+        self.fn_of = {}               # fn id -> (ctx, fn node)
+        # -- fixpoint summaries --
+        self.entry_held = {}          # fn id -> frozenset (must-hold)
+        self.sum_acquires = collections.defaultdict(frozenset)
+        self.sum_blocks = collections.defaultdict(tuple)
+        self.reaches_collective = set()
+        self.reaches_span = set()
+        self.reaches_scope = set()
+        # -- derived --
+        self.pairs = {}               # (a, b) -> witness dict
+
+        self._build_class_model()
+        self._build_lock_model()
+        self._scan_functions()
+        self._build_thread_model()
+        self._run_fixpoint()
+        self._derive_pairs()
+
+    # -- model construction ------------------------------------------------
+
+    def _class_qual(self, ctx, cls):
+        return '%s.%s' % (getattr(ctx, 'module', ctx.path), cls.name)
+
+    def _build_class_model(self):
+        for ctx in self.project.contexts:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                cq = self._class_qual(ctx, node)
+                methods = {}
+                for st in node.body:
+                    if isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        methods[st.name] = st
+                        self.fn_class[id(st)] = cq
+                        self.method_tails[st.name].append((ctx, st))
+                bases = [ctx.qual(b) or '' for b in node.bases]
+                self.classes[cq] = {'ctx': ctx, 'node': node,
+                                    'methods': methods, 'bases': bases}
+
+    def _construction_kind(self, ctx, value):
+        """('lock'|'condition'|...|'queue'|'class:<qual>', call) for a
+        recognized constructor Call, else (None, None)."""
+        if not isinstance(value, ast.Call):
+            return None, None
+        q = ctx.call_name(value)
+        if q is None:
+            return None, None
+        head, _, tail = q.rpartition('.')
+        headtail = head.rsplit('.', 1)[-1] if head else ''
+        if tail in _LOCK_KINDS and headtail in ('threading', ''):
+            return _LOCK_KINDS[tail], value
+        if tail in _QUEUE_TAILS and headtail in ('queue', ''):
+            return 'queue', value
+        # a project-class instantiation: 'mod.Class' or unique tail
+        cq = self._lookup_class(q)
+        if cq is not None:
+            return 'class:%s' % cq, value
+        return None, None
+
+    def _lookup_class(self, q):
+        if q in self.classes:
+            return q
+        tail = q.rsplit('.', 1)[-1]
+        cands = [cq for cq in self.classes
+                 if cq.rsplit('.', 1)[-1] == tail]
+        if len(cands) == 1:
+            return cands[0]
+        # suffix match ('pkg.m1.C' vs fixture-relative 'm1.C')
+        cands = [cq for cq in self.classes if cq.endswith('.' + q)]
+        return cands[0] if len(cands) == 1 else None
+
+    def _build_lock_model(self):
+        pending_aliases = []      # (ctx, fn, ident, arg expr)
+        for ctx in self.project.contexts:
+            mod = getattr(ctx, 'module', ctx.path)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                kind, call = self._construction_kind(ctx, node.value)
+                if kind is None:
+                    continue
+                fn = ctx.enclosing_function(node)
+                for target in node.targets:
+                    ident = None
+                    if isinstance(target, ast.Name):
+                        if fn is None:
+                            ident = '%s.%s' % (mod, target.id)
+                        elif kind.startswith('class:') or \
+                                kind == 'queue':
+                            continue
+                        else:
+                            ident = '%s.%s.%s' % (
+                                mod, getattr(fn, 'name', '<lambda>'),
+                                target.id)
+                            self.local_locks[(id(fn), target.id)] = \
+                                ident
+                    elif isinstance(target, ast.Attribute) and \
+                            isinstance(target.value, ast.Name) and \
+                            target.value.id == 'self' and fn is not None:
+                        cq = self.fn_class.get(id(fn))
+                        if cq is None:
+                            continue
+                        ident = '%s.%s' % (cq, target.attr)
+                    if ident is None:
+                        continue
+                    if kind == 'queue':
+                        self.queues.add(ident)
+                    elif kind.startswith('class:'):
+                        self.instance_class[ident] = kind[6:]
+                    else:
+                        self.locks[ident] = {'kind': kind, 'ctx': ctx,
+                                             'node': node}
+                        if kind == 'condition' and call.args:
+                            pending_aliases.append(
+                                (ctx, fn, ident, call.args[0]))
+        # second pass: Condition(wrapped_lock) aliases resolve once
+        # every construction site is known
+        for ctx, fn, ident, arg in pending_aliases:
+            wrapped = self._lock_ident(ctx, fn, arg)
+            if wrapped is not None and wrapped != ident:
+                self.alias[ident] = wrapped
+
+    # -- identity resolution -----------------------------------------------
+
+    def _suffix_lookup(self, table, ident):
+        if ident in table:
+            return ident
+        cands = [k for k in table if k.endswith('.' + ident)]
+        return cands[0] if len(cands) == 1 else None
+
+    def _attr_chain_ident(self, ctx, fn, expr):
+        """Canonical identity for ``self.a.b`` / ``NAME.a`` chains via
+        the instance-class map, or None."""
+        chain = []
+        node = expr
+        while isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+            node = node.value
+        chain.reverse()
+        if not isinstance(node, ast.Name) or not chain:
+            return None
+        if node.id == 'self':
+            if fn is None:
+                return None
+            cq = self.fn_class.get(id(fn))
+            if cq is None:
+                return None
+        else:
+            q = ctx.qual(node) or node.id
+            base = q if '.' in q else \
+                '%s.%s' % (getattr(ctx, 'module', ctx.path), q)
+            hit = self._suffix_lookup(self.instance_class, base) or \
+                self._suffix_lookup(self.instance_class, q)
+            if hit is not None:
+                cq = self.instance_class[hit]
+            else:
+                # the chain may simply be a dotted module global
+                # (``export._lock``): return it verbatim for the
+                # caller's table lookup
+                return '.'.join([base] + chain)
+        for attr in chain[:-1]:
+            nxt = self.instance_class.get('%s.%s' % (cq, attr))
+            if nxt is None:
+                return None
+            cq = nxt
+        return '%s.%s' % (cq, chain[-1])
+
+    def _raw_ident(self, ctx, fn, expr):
+        if isinstance(expr, ast.Name):
+            if fn is not None:
+                hit = self.local_locks.get((id(fn), expr.id))
+                if hit is not None:
+                    return hit
+            q = ctx.qual(expr) or expr.id
+            if '.' in q:
+                return q
+            return '%s.%s' % (getattr(ctx, 'module', ctx.path), q)
+        if isinstance(expr, ast.Attribute):
+            return self._attr_chain_ident(ctx, fn, expr)
+        return None
+
+    def canon(self, ident):
+        """Follow the Condition alias to the underlying lock."""
+        seen = 0
+        while ident in self.alias and seen < 4:
+            ident = self.alias[ident]
+            seen += 1
+        return ident
+
+    def _lock_ident(self, ctx, fn, expr):
+        """The canonical lock identity an expression denotes, or
+        None when it does not (resolvably) name a lock."""
+        raw = self._raw_ident(ctx, fn, expr)
+        if raw is None:
+            return None
+        hit = self._suffix_lookup(self.locks, raw)
+        if hit is None and raw in self.alias:
+            hit = raw
+        if hit is None:
+            # the raw ident may BE an alias key by suffix
+            cands = [k for k in self.alias if k.endswith('.' + raw)]
+            hit = cands[0] if len(cands) == 1 else None
+        if hit is None:
+            return None
+        return self.canon(hit)
+
+    def _is_queue(self, ctx, fn, expr):
+        raw = self._raw_ident(ctx, fn, expr)
+        return raw is not None and \
+            self._suffix_lookup(self.queues, raw) is not None
+
+    # -- call resolution ---------------------------------------------------
+
+    def _class_method(self, cq, name, depth=0):
+        info = self.classes.get(cq)
+        if info is None or depth > 4:
+            return None
+        fn = info['methods'].get(name)
+        if fn is not None:
+            return (info['ctx'], fn)
+        for base in info['bases']:
+            bq = self._lookup_class(base) if base else None
+            if bq is not None:
+                hit = self._class_method(bq, name, depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _resolve_func(self, ctx, fn, expr):
+        """(ctx, fn node) for a function-valued expression: methods
+        through self/instance chains, module-level defs through the
+        project graph, unique method tails as a guarded fallback."""
+        if isinstance(expr, ast.Attribute):
+            chain = []
+            node = expr
+            while isinstance(node, ast.Attribute):
+                chain.append(node.attr)
+                node = node.value
+            chain.reverse()
+            if isinstance(node, ast.Name):
+                cq = None
+                if node.id == 'self' and fn is not None:
+                    cq = self.fn_class.get(id(fn))
+                else:
+                    q = ctx.qual(node) or node.id
+                    base = q if '.' in q else \
+                        '%s.%s' % (getattr(ctx, 'module', ctx.path), q)
+                    hit = self._suffix_lookup(self.instance_class,
+                                              base)
+                    if hit is not None:
+                        cq = self.instance_class[hit]
+                if cq is not None:
+                    for attr in chain[:-1]:
+                        nxt = self.instance_class.get(
+                            '%s.%s' % (cq, attr))
+                        if nxt is None:
+                            cq = None
+                            break
+                        cq = nxt
+                    if cq is not None:
+                        hit = self._class_method(cq, chain[-1])
+                        if hit is not None:
+                            return hit
+                        # receiver class known, method absent: a
+                        # stdlib/runtime attribute — do NOT fall back
+                        return None
+            # an attribute call with a generic stdlib-shaped tail on
+            # an unresolved receiver (f.write, q.get, ...) must NOT
+            # fall through to the project's unique-tail matching — a
+            # false edge there poisons every summary above it
+            if expr.attr in _FALLBACK_BLOCKLIST:
+                return None
+        ref = self.project.resolve_name(ctx, expr, expr)
+        if ref is not None and not isinstance(ref.node, ast.Lambda):
+            return (ref.ctx, ref.node)
+        # guarded unique-tail fallback over methods
+        q = ctx.qual(expr)
+        if q is not None:
+            tail = q.rsplit('.', 1)[-1]
+            if tail not in _FALLBACK_BLOCKLIST:
+                cands = self.method_tails.get(tail, ())
+                if len(cands) == 1 and \
+                        not self.project.by_tail.get(tail):
+                    return cands[0]
+        return None
+
+    def _resolve_call_target(self, ctx, fn, call):
+        if not isinstance(call.func, (ast.Name, ast.Attribute)):
+            return None
+        return self._resolve_func(ctx, fn, call.func)
+
+    # -- lexical scan ------------------------------------------------------
+
+    def _scan_functions(self):
+        for ctx, fn in self.project.functions():
+            self.fn_of[id(fn)] = (ctx, fn)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            if isinstance(fn, ast.Lambda):
+                self._scan_expr(ctx, fn, fn.body, frozenset())
+            else:
+                self._globals_of = {
+                    n for st in ast.walk(fn)
+                    if isinstance(st, ast.Global) for n in st.names}
+                self._walk_stmts(ctx, fn, body, frozenset())
+
+    def _walk_stmts(self, ctx, fn, stmts, held):
+        """One pass over a statement list: ``held`` is the lock set
+        lexically held entering the list; bare ``acquire()`` extends
+        it for the remainder of the list."""
+        held = set(held)
+        for i, st in enumerate(stmts):
+            if isinstance(st, _FUNC_NODES + (ast.ClassDef,)):
+                continue        # nested defs scan on their own
+            if isinstance(st, ast.With) or \
+                    isinstance(st, getattr(ast, 'AsyncWith', ())):
+                inner = set(held)
+                for item in st.items:
+                    lid = self._lock_ident(ctx, fn,
+                                           item.context_expr)
+                    if lid is not None:
+                        self.acquires[id(fn)].append(
+                            Acquire(frozenset(inner), lid, st))
+                        inner.add(lid)
+                    else:
+                        self._scan_expr(ctx, fn, item.context_expr,
+                                        frozenset(inner))
+                self._walk_stmts(ctx, fn, st.body, frozenset(inner))
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                self._scan_expr(ctx, fn, st.test, frozenset(held))
+                self._walk_stmts(ctx, fn, st.body, frozenset(held))
+                self._walk_stmts(ctx, fn, st.orelse, frozenset(held))
+                continue
+            if isinstance(st, (ast.For, getattr(ast, 'AsyncFor',
+                                                ast.For))):
+                self._scan_expr(ctx, fn, st.iter, frozenset(held))
+                self._walk_stmts(ctx, fn, st.body, frozenset(held))
+                self._walk_stmts(ctx, fn, st.orelse, frozenset(held))
+                continue
+            if isinstance(st, ast.Try):
+                self._walk_stmts(ctx, fn, st.body, frozenset(held))
+                for h in st.handlers:
+                    self._walk_stmts(ctx, fn, h.body, frozenset(held))
+                self._walk_stmts(ctx, fn, st.orelse, frozenset(held))
+                self._walk_stmts(ctx, fn, st.finalbody,
+                                 frozenset(held))
+                continue
+            # flat statement: record writes, classify calls, track
+            # bare acquire/release for the rest of this list
+            self._record_writes(ctx, fn, st, frozenset(held))
+            acq, rel = self._scan_expr_stmt(ctx, fn, st,
+                                            frozenset(held))
+            held |= acq
+            held -= rel
+
+    def _record_writes(self, ctx, fn, st, held):
+        if isinstance(fn, ast.Lambda) or \
+                getattr(fn, 'name', '') == '__init__':
+            return
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, ast.AugAssign):
+            targets = [st.target]
+        for t in targets:
+            state = None
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == 'self':
+                cq = self.fn_class.get(id(fn))
+                if cq is not None:
+                    state = '%s.%s' % (cq, t.attr)
+            elif isinstance(t, ast.Name) and \
+                    t.id in getattr(self, '_globals_of', ()):
+                state = '%s.%s' % (getattr(ctx, 'module', ctx.path),
+                                   t.id)
+            if state is not None and state not in self.locks and \
+                    self.canon(state) not in self.locks:
+                self.writes[id(fn)].append(Write(state, held, st))
+
+    def _scan_expr_stmt(self, ctx, fn, st, held):
+        """Scan a flat statement's expressions; returns the set of
+        locks bare-``acquire()``d / ``release()``d by it."""
+        acq, rel = set(), set()
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.enclosing_function(node) is not fn:
+                continue        # inside a nested lambda
+            lk = self._acquire_release(ctx, fn, node)
+            if lk is not None:
+                which, lid = lk
+                if which == 'acquire':
+                    self.acquires[id(fn)].append(
+                        Acquire(held, lid, node))
+                    self.bare_acquires[id(fn)].append((lid, node, st))
+                    acq.add(lid)
+                else:
+                    rel.add(lid)
+                continue
+            self._classify_call(ctx, fn, node, held)
+        return acq, rel
+
+    def _scan_expr(self, ctx, fn, expr, held):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and \
+                    ctx.enclosing_function(node) is fn:
+                lk = self._acquire_release(ctx, fn, node)
+                if lk is not None:
+                    which, lid = lk
+                    if which == 'acquire':
+                        self.acquires[id(fn)].append(
+                            Acquire(held, lid, node))
+                        self.bare_acquires[id(fn)].append(
+                            (lid, node, None))
+                    continue
+                self._classify_call(ctx, fn, node, held)
+
+    def _acquire_release(self, ctx, fn, call):
+        if not isinstance(call.func, ast.Attribute) or \
+                call.func.attr not in ('acquire', 'release'):
+            return None
+        lid = self._lock_ident(ctx, fn, call.func.value)
+        if lid is None:
+            return None
+        return call.func.attr, lid
+
+    def _classify_call(self, ctx, fn, call, held):
+        q = ctx.call_name(call) or ''
+        tail = q.rsplit('.', 1)[-1]
+        # seeds for the reach summaries
+        if tail == 'span':
+            self.has_span.add(id(fn))
+        elif tail == 'trace_scope':
+            self.has_scope.add(id(fn))
+        if ctx.is_collective(call):
+            self.has_collective.add(id(fn))
+            if held:
+                self.blocking[id(fn)].append(Blocking(
+                    held, 'collective', tail, call))
+            return
+        # thread spawns / handler registrations: the argument runs on
+        # another thread (or at exit) with nothing held — record the
+        # spawn, do NOT add a call edge
+        if self._record_spawn(ctx, fn, call, q, tail):
+            return
+        b = self._blocking_kind(ctx, fn, call, q, tail)
+        if b is not None:
+            self.blocking[id(fn)].append(Blocking(
+                held, b[0], b[1], call))
+        # call edge (methods resolved through the class model)
+        target = self._resolve_call_target(ctx, fn, call)
+        if target is not None:
+            self.edges[id(fn)].append(
+                Edge(id(target[1]), held, call))
+            self.fn_of.setdefault(id(target[1]), target)
+        # function-valued arguments (min(key=...), callbacks) are
+        # conservatively edges too: they may run with ``held`` held
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                tgt = self._resolve_func(ctx, fn, arg)
+                if tgt is not None:
+                    self.edges[id(fn)].append(
+                        Edge(id(tgt[1]), held, call))
+                    self.fn_of.setdefault(id(tgt[1]), tgt)
+
+    def _record_spawn(self, ctx, fn, call, q, tail):
+        head = q.rpartition('.')[0].rsplit('.', 1)[-1]
+        target_expr = label = kind = None
+        if tail in _SPAWN_TAILS and head in ('threading', ''):
+            kind = 'thread' if tail == 'Thread' else 'timer'
+            for kw in call.keywords:
+                if kw.arg == 'target':
+                    target_expr = kw.value
+                if kw.arg == 'name' and \
+                        isinstance(kw.value, ast.Constant):
+                    label = str(kw.value.value)
+            if tail == 'Timer' and len(call.args) > 1:
+                target_expr = call.args[1]
+        elif q == 'atexit.register' and call.args:
+            kind, target_expr = 'atexit', call.args[0]
+        elif q == 'signal.signal' and len(call.args) > 1:
+            kind, target_expr = 'signal', call.args[1]
+        if kind is None:
+            return False
+        tgt = None
+        if target_expr is not None:
+            tgt = self._resolve_func(ctx, fn, target_expr)
+            if tgt is None and isinstance(target_expr, ast.Lambda):
+                tgt = (ctx, target_expr)
+        if label is None:
+            if target_expr is not None and \
+                    isinstance(target_expr, (ast.Name, ast.Attribute)):
+                label = (ctx.qual(target_expr) or
+                         'line%d' % call.lineno).rsplit('.', 1)[-1]
+            else:
+                label = 'line%d' % call.lineno
+        label = '%s:%s' % (kind, label)
+        sp = Spawn(label, id(tgt[1]) if tgt else None, call)
+        self.spawns.append((ctx, fn, sp))
+        self.root_info.setdefault(label, {
+            'ctx': ctx, 'node': call, 'kind': kind,
+            'target': tgt[1] if tgt else None})
+        if tgt is not None:
+            self.threads[id(tgt[1])].add(label)
+            self.fn_of.setdefault(id(tgt[1]), tgt)
+        return True
+
+    def _blocking_kind(self, ctx, fn, call, q, tail):
+        kw = {k.arg for k in call.keywords}
+        head = q.rpartition('.')[0]
+        headtail = head.rsplit('.', 1)[-1] if head else ''
+        if tail == 'join' and not call.args and 'timeout' not in kw:
+            return ('join', q)
+        if tail == 'wait' and not call.args and 'timeout' not in kw:
+            # a Condition.wait releases its OWN lock while waiting:
+            # it only blocks with respect to the other held locks
+            if isinstance(call.func, ast.Attribute):
+                own = self._lock_ident(ctx, fn, call.func.value)
+                if own is not None:
+                    return ('wait-other', own)
+            return ('wait', q)
+        if tail in ('get', 'put') and 'timeout' not in kw:
+            if isinstance(call.func, ast.Attribute) and \
+                    self._is_queue(ctx, fn, call.func.value):
+                if tail == 'get' and not call.args:
+                    return ('queue', q)
+                if tail == 'put' and len(call.args) <= 1:
+                    return ('queue', q)
+            return None
+        if tail in _NET_BLOCK_TAILS:
+            return ('net', q)
+        if tail in _SUBPROCESS_TAILS and headtail == 'subprocess':
+            return ('subprocess', q)
+        return None
+
+    # -- thread-entry model ------------------------------------------------
+
+    def _build_thread_model(self):
+        # HTTP handler classes: ThreadingHTTPServer runs do_* on a
+        # fresh thread per request
+        for cq, info in self.classes.items():
+            bases = {b.rsplit('.', 1)[-1] for b in info['bases']}
+            if not bases & _HANDLER_BASES:
+                continue
+            label = 'httpd:%s' % cq.rsplit('.', 1)[-1]
+            for name, m in info['methods'].items():
+                if name.startswith('do_') or name in ('handle',
+                                                      'handle_one'):
+                    self.threads[id(m)].add(label)
+                    self.root_info.setdefault(label, {
+                        'ctx': info['ctx'], 'node': info['node'],
+                        'kind': 'httpd', 'target': m})
+
+    # -- fixpoint ----------------------------------------------------------
+
+    def _run_fixpoint(self):
+        fn_ids = list(self.fn_of)
+        all_locks = frozenset(self.canon(k) for k in self.locks)
+        # entry_held: must-hold at entry = intersection over call
+        # sites of (lexical held + caller's entry_held); thread roots
+        # enter with nothing held
+        callers = collections.defaultdict(list)
+        for fid in fn_ids:
+            for e in self.edges.get(fid, ()):
+                callers[e.callee].append((fid, e.held))
+        for fid in fn_ids:
+            if self.threads.get(fid) or not callers.get(fid):
+                self.entry_held[fid] = frozenset()
+            else:
+                self.entry_held[fid] = all_locks
+        for _ in range(_MAX_PASSES):
+            changed = False
+            for fid in fn_ids:
+                if self.threads.get(fid):
+                    new = frozenset()
+                else:
+                    sites = callers.get(fid)
+                    if not sites:
+                        new = frozenset()
+                    else:
+                        new = None
+                        for cfid, held in sites:
+                            cand = held | self.entry_held.get(
+                                cfid, frozenset())
+                            new = cand if new is None else new & cand
+                if new != self.entry_held.get(fid):
+                    self.entry_held[fid] = new
+                    changed = True
+            if not changed:
+                break
+        # forward summaries: acquires / blocking / reach flags /
+        # thread roots, unioned over call edges
+        for fid in fn_ids:
+            self.sum_acquires[fid] = frozenset(
+                a.lock for a in self.acquires.get(fid, ()))
+            blocks = []
+            for b in self.blocking.get(fid, ()):
+                if b.kind == 'wait-other':
+                    continue    # only blocks w.r.t. the caller's
+                    # OTHER locks; modeled lexically, not spliced
+                blocks.append(('%s:%s' % (b.kind, b.detail),
+                               b.node.lineno))
+            self.sum_blocks[fid] = tuple(blocks[:_MAX_BLOCK_SUMMARY])
+        self.reaches_collective = set(self.has_collective)
+        self.reaches_span = set(self.has_span)
+        self.reaches_scope = set(self.has_scope)
+        for _ in range(_MAX_PASSES):
+            changed = False
+            for fid in fn_ids:
+                acc_a = set(self.sum_acquires[fid])
+                acc_b = dict(self.sum_blocks[fid])
+                roots = self.threads.get(fid, set())
+                for e in self.edges.get(fid, ()):
+                    acc_a |= self.sum_acquires.get(e.callee,
+                                                   frozenset())
+                    for k, ln in self.sum_blocks.get(e.callee, ()):
+                        if len(acc_b) < _MAX_BLOCK_SUMMARY:
+                            acc_b.setdefault(k, ln)
+                    if e.callee in self.reaches_collective:
+                        if fid not in self.reaches_collective:
+                            self.reaches_collective.add(fid)
+                            changed = True
+                    if e.callee in self.reaches_span and \
+                            fid not in self.reaches_span:
+                        self.reaches_span.add(fid)
+                        changed = True
+                    if e.callee in self.reaches_scope and \
+                            fid not in self.reaches_scope:
+                        self.reaches_scope.add(fid)
+                        changed = True
+                    # roots flow FORWARD: a callee runs on every
+                    # thread its callers run on
+                    tgt = self.threads.setdefault(e.callee, set())
+                    before = len(tgt)
+                    tgt |= roots
+                    if len(tgt) != before:
+                        changed = True
+                if frozenset(acc_a) != self.sum_acquires[fid]:
+                    self.sum_acquires[fid] = frozenset(acc_a)
+                    changed = True
+                new_b = tuple(sorted(
+                    (k, ln) for k, ln in acc_b.items()
+                ))[:_MAX_BLOCK_SUMMARY]
+                if new_b != self.sum_blocks[fid]:
+                    self.sum_blocks[fid] = new_b
+                    changed = True
+            if not changed:
+                break
+
+    # -- derived: ordered pairs for NBK801 ---------------------------------
+
+    def _derive_pairs(self):
+        for fid, (ctx, fn) in list(self.fn_of.items()):
+            entry = self.entry_held.get(fid, frozenset())
+            for a in self.acquires.get(fid, ()):
+                outer = a.held | entry
+                for lo in outer:
+                    if lo != a.lock:
+                        self.pairs.setdefault(
+                            (lo, a.lock),
+                            {'ctx': ctx, 'node': a.node,
+                             'via': None})
+            for e in self.edges.get(fid, ()):
+                if not e.held:
+                    continue
+                inner = self.sum_acquires.get(e.callee, frozenset())
+                cname = getattr(
+                    self.fn_of.get(e.callee, (None, None))[1],
+                    'name', '?')
+                for lo in e.held | entry:
+                    for li in inner:
+                        if lo != li:
+                            self.pairs.setdefault(
+                                (lo, li),
+                                {'ctx': ctx, 'node': e.node,
+                                 'via': cname})
+
+    # -- finding producers (consumed by rules.py) --------------------------
+
+    def lock_inversions(self, ctx):
+        """NBK801: (node, message, hint) witnesses anchored in ctx."""
+        seen = set()
+        for (a, b), w in sorted(
+                self.pairs.items(),
+                key=lambda kv: (kv[1]['node'].lineno, kv[0])):
+            if (b, a) not in self.pairs:
+                continue
+            key = frozenset((a, b))
+            if key in seen:
+                continue
+            seen.add(key)
+            other = self.pairs[(b, a)]
+            # report at both witnesses, each in its own module pass
+            for mine, theirs, first, second in (
+                    (w, other, a, b), (other, w, b, a)):
+                if mine['ctx'] is not ctx:
+                    continue
+                via = ' (via call to %s())' % mine['via'] \
+                    if mine['via'] else ''
+                yield (mine['node'],
+                       'lock-order inversion: %s is acquired while '
+                       'holding %s here%s, but the opposite order '
+                       'exists at %s:%d — two threads can deadlock'
+                       % (_short(second), _short(first), via,
+                          theirs['ctx'].path,
+                          theirs['node'].lineno),
+                       'pick one global order for %s and %s and '
+                       'acquire them in that order on every path '
+                       '(or drop to a snapshot-then-probe pattern '
+                       'that never holds both)'
+                       % (_short(first), _short(second)))
+
+    def shared_state_races(self, ctx):
+        """NBK802: unguarded multi-thread writes anchored in ctx."""
+        by_state = collections.defaultdict(list)
+        for fid, writes in self.writes.items():
+            fctx, fn = self.fn_of[fid]
+            roots = self.threads.get(fid) or {'main'}
+            entry = self.entry_held.get(fid, frozenset())
+            for w in writes:
+                by_state[w.state].append(
+                    (fctx, fn, roots,
+                     frozenset(self.canon(h) for h in w.held)
+                     | entry, w.node))
+        for state, accesses in sorted(by_state.items()):
+            contexts = set()
+            for _, _, roots, _, _ in accesses:
+                contexts |= roots
+            if len(contexts) < 2:
+                continue
+            common = None
+            for _, _, _, held, _ in accesses:
+                common = held if common is None else common & held
+            if common:
+                continue
+            unguarded = [a for a in accesses if not a[3]]
+            witness = unguarded[0] if unguarded else accesses[0]
+            wctx, fn, _, _, node = witness
+            if wctx is not ctx:
+                continue
+            others = sorted({'%s (%s)' % (getattr(f, 'name', '?'),
+                                          '/'.join(sorted(r)))
+                             for _, f, r, _, _ in accesses})
+            yield (node,
+                   'shared state %s is written from %d thread '
+                   'context(s) [%s] with no common lock held at '
+                   'every write' % (_short(state), len(contexts),
+                                    ', '.join(others)),
+                   'guard every write with one lock (hold it in '
+                   'each writer), or confine the attribute to a '
+                   'single thread and publish via a Queue/Event')
+
+    def blocking_under_lock(self, ctx):
+        """NBK803: blocking calls with a non-empty held-set."""
+        for fid, (fctx, fn) in self.fn_of.items():
+            if fctx is not ctx:
+                continue
+            for b in self.blocking.get(fid, ()):
+                held = b.held
+                if b.kind == 'wait-other':
+                    held = held - {b.detail}
+                    if not held:
+                        continue
+                    kindmsg = 'wait() (no timeout) on another ' \
+                        'lock\'s condition'
+                elif b.kind == 'collective':
+                    kindmsg = 'JAX collective %r' % b.detail
+                else:
+                    kindmsg = {'join': 'join() with no timeout',
+                               'wait': 'wait() with no timeout',
+                               'queue': 'queue %s with no timeout'
+                               % b.detail.rsplit('.', 1)[-1],
+                               'net': 'network call %s' % b.detail,
+                               'subprocess': 'subprocess call %s'
+                               % b.detail}.get(b.kind, b.detail)
+                if not held:
+                    continue
+                yield (b.node,
+                       'blocking call (%s) while holding %s — every '
+                       'thread needing the lock wedges behind it'
+                       % (kindmsg,
+                          ', '.join(sorted(_short(h) for h in held))),
+                       'move the blocking call outside the lock '
+                       '(snapshot under the lock, block outside), '
+                       'or bound it with a timeout')
+            # spliced: a call made under a lock whose summary blocks.
+            # sum_blocks carries lexical blocking records; the
+            # reaches_collective flag covers the chain case — a
+            # callee whose own collective call is NOT under any lock
+            # locally, but becomes blocking-under-lock through this
+            # edge (a collective is a device-synchronous barrier:
+            # every other host must reach it too, and they cannot if
+            # they are wedged behind this lock)
+            for e in self.edges.get(fid, ()):
+                if not e.held:
+                    continue
+                blocks = self.sum_blocks.get(e.callee, ())
+                kindset = {k for k, _ in blocks}
+                if e.callee in self.reaches_collective and \
+                        not any(k.startswith('collective')
+                                for k in kindset):
+                    kindset.add('collective (via call chain)')
+                if not kindset:
+                    continue
+                kinds = ', '.join(sorted(kindset))
+                cname = getattr(
+                    self.fn_of.get(e.callee, (None, None))[1],
+                    'name', '?')
+                yield (e.node,
+                       'call to %s() while holding %s — its summary '
+                       'reaches blocking operation(s): %s'
+                       % (cname,
+                          ', '.join(sorted(_short(h)
+                                           for h in e.held)),
+                          kinds),
+                       'hoist the %s() call out of the locked '
+                       'region, or push the blocking work past the '
+                       'lock release' % cname)
+
+    def unreleased_acquires(self, ctx):
+        """NBK804: bare acquire() with no with/try-finally release."""
+        for fid, (fctx, fn) in self.fn_of.items():
+            if fctx is not ctx:
+                continue
+            bares = self.bare_acquires.get(fid)
+            if not bares:
+                continue
+            # any try/finally releasing the same lock inside this
+            # function counts as the release discipline
+            guarded = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Try) or not node.finalbody:
+                    continue
+                for f in node.finalbody:
+                    for c in ast.walk(f):
+                        if isinstance(c, ast.Call):
+                            lk = self._acquire_release(fctx, fn, c)
+                            if lk is not None and lk[0] == 'release':
+                                guarded.add(lk[1])
+            for lid, node, _ in bares:
+                if lid in guarded:
+                    continue
+                yield (node,
+                       '%s.acquire() is not paired with a release '
+                       'in a finally (and is not a with-statement) — '
+                       'an exception between acquire and release '
+                       'leaves the lock held forever'
+                       % _short(lid),
+                       'use "with %s:" (or wrap the region in '
+                       'try/finally with the release in finally)'
+                       % _short(lid).rsplit('.', 1)[-1])
+
+    def context_dropping_spawns(self, ctx):
+        """NBK805: Thread targets that emit spans with no
+        trace_scope propagation."""
+        for sctx, fn, sp in self.spawns:
+            if sctx is not ctx or sp.target is None:
+                continue
+            if sp.target in self.reaches_span and \
+                    sp.target not in self.reaches_scope:
+                tname = getattr(
+                    self.fn_of.get(sp.target, (None, None))[1],
+                    'name', '?')
+                yield (sp.node,
+                       'thread target %s() reaches span emission but '
+                       'never enters trace_scope — its spans land '
+                       'orphaned, outside any request waterfall'
+                       % tname,
+                       'carry the request context across the hop: '
+                       'with trace_scope(ticket.ctx): ... inside the '
+                       'thread body (diagnostics/trace.py), or emit '
+                       'out-of-band via emit_span(..., ctx=...)')
+
+
+def _short(ident):
+    """A readable lock/state identity: strip the package prefix."""
+    parts = ident.split('.')
+    return '.'.join(parts[-3:]) if len(parts) > 3 else ident
+
+
+def analysis_for(project):
+    """The per-project cached analysis (the collectives.py idiom)."""
+    cached = getattr(project, '_conc_analysis', None)
+    if cached is None:
+        cached = _Analysis(project)
+        project._conc_analysis = cached
+    return cached
+
+
+def _project_of(ctx):
+    project = getattr(ctx, 'project', None)
+    if project is None:
+        from .callgraph import single_project
+        project = single_project(ctx)
+    return project
+
+
+def find_lock_inversions(ctx):
+    return analysis_for(_project_of(ctx)).lock_inversions(ctx)
+
+
+def find_shared_state_races(ctx):
+    return analysis_for(_project_of(ctx)).shared_state_races(ctx)
+
+
+def find_blocking_under_lock(ctx):
+    return analysis_for(_project_of(ctx)).blocking_under_lock(ctx)
+
+
+def find_unreleased_acquires(ctx):
+    return analysis_for(_project_of(ctx)).unreleased_acquires(ctx)
+
+
+def find_context_dropping_spawns(ctx):
+    return analysis_for(_project_of(ctx)).context_dropping_spawns(ctx)
+
+
+# ---------------------------------------------------------------------------
+# reports
+
+
+def lock_report(project):
+    """Rows for ``--lock-report``: every lock identity with its
+    construction site, kind, acquiring thread roots, the largest
+    held-set observed at any of its acquisitions, and the blocking
+    calls issued while it is held."""
+    ana = analysis_for(project)
+    rows = {}
+    for ident, info in ana.locks.items():
+        canon = ana.canon(ident)
+        row = rows.setdefault(canon, {
+            'lock': canon, 'kind': info['kind'],
+            'path': info['ctx'].path, 'line': info['node'].lineno,
+            'aliases': [], 'threads': set(), 'max_held': set(),
+            'blocking': set(), 'acquire_sites': 0})
+        if ident != canon:
+            row['aliases'].append(ident)
+            return_kind = ana.locks.get(canon)
+            if return_kind is not None:
+                row['kind'] = return_kind['kind']
+    for fid, acquires in ana.acquires.items():
+        fctx, fn = ana.fn_of[fid]
+        roots = ana.threads.get(fid) or {'main'}
+        entry = ana.entry_held.get(fid, frozenset())
+        for a in acquires:
+            row = rows.get(a.lock)
+            if row is None:
+                continue
+            row['threads'] |= roots
+            row['acquire_sites'] += 1
+            full = set(a.held) | set(entry) | {a.lock}
+            if len(full) > len(row['max_held']):
+                row['max_held'] = full
+    for fid, blocks in ana.blocking.items():
+        for b in blocks:
+            held = b.held - ({b.detail}
+                             if b.kind == 'wait-other' else set())
+            for h in held:
+                row = rows.get(h)
+                if row is not None:
+                    row['blocking'].add(
+                        '%s@%d' % (b.kind, b.node.lineno))
+    out = []
+    for canon in sorted(rows):
+        r = rows[canon]
+        out.append({
+            'lock': canon, 'kind': r['kind'], 'path': r['path'],
+            'line': r['line'], 'aliases': sorted(r['aliases']),
+            'threads': sorted(r['threads']),
+            'acquire_sites': r['acquire_sites'],
+            'max_held': sorted(r['max_held']),
+            'blocking': sorted(r['blocking']),
+        })
+    return out
+
+
+def render_lock_report(rows):
+    out = ['host-concurrency lock report: %d lock identit%s'
+           % (len(rows), 'y' if len(rows) == 1 else 'ies'), '']
+    for r in rows:
+        out.append('%s  [%s]  %s:%d' % (r['lock'], r['kind'],
+                                        r['path'], r['line']))
+        if r['aliases']:
+            out.append('    aliased by: %s'
+                       % ', '.join(_short(a) for a in r['aliases']))
+        out.append('    acquired by: %s  (%d site%s)'
+                   % (', '.join(r['threads']) or '-',
+                      r['acquire_sites'],
+                      '' if r['acquire_sites'] == 1 else 's'))
+        if len(r['max_held']) > 1:
+            out.append('    max held-set: %s'
+                       % ', '.join(_short(h) for h in r['max_held']))
+        if r['blocking']:
+            out.append('    blocking under it: %s'
+                       % ', '.join(r['blocking']))
+        out.append('')
+    return '\n'.join(out)
+
+
+def threads_report(project):
+    """Rows for ``--threads-report``: every thread root with its
+    spawn site and the functions it reaches."""
+    ana = analysis_for(project)
+    reach = collections.defaultdict(list)
+    for fid, roots in ana.threads.items():
+        entry = ana.fn_of.get(fid)
+        if entry is None:
+            continue
+        name = getattr(entry[1], 'name', '<lambda>')
+        for r in roots:
+            reach[r].append(name)
+    out = []
+    for label in sorted(ana.root_info):
+        info = ana.root_info[label]
+        tgt = info.get('target')
+        out.append({
+            'root': label, 'kind': info['kind'],
+            'path': info['ctx'].path,
+            'line': info['node'].lineno,
+            'target': getattr(tgt, 'name', None),
+            'reaches': sorted(set(reach.get(label, ()))),
+        })
+    return out
+
+
+def render_threads_report(rows):
+    out = ['host-concurrency thread report: %d root%s'
+           % (len(rows), '' if len(rows) == 1 else 's'), '']
+    for r in rows:
+        out.append('%s  [%s]  %s:%d%s'
+                   % (r['root'], r['kind'], r['path'], r['line'],
+                      '  -> %s()' % r['target'] if r['target']
+                      else ''))
+        out.append('    reaches %d function(s): %s'
+                   % (len(r['reaches']),
+                      ', '.join(r['reaches'][:10])
+                      + (' ...' if len(r['reaches']) > 10 else '')))
+        out.append('')
+    return '\n'.join(out)

@@ -123,6 +123,47 @@ EXAMPLES = {
         "    raise ValueError('index overflows int32')\n"
         "# or bound N with a module constant so the range is\n"
         "# provable < 2**31 (then the rule is silent by proof)"),
+    'NBK801': (
+        "def route(self):\n"
+        "    with self.router_lock:\n"
+        "        with self.server_lock: ...   # order A->B\n"
+        "def drain(self):\n"
+        "    with self.server_lock:\n"
+        "        with self.router_lock: ...   # order B->A: deadlock",
+        "# pick ONE global order and use it on every path:\n"
+        "def drain(self):\n"
+        "    with self.router_lock:\n"
+        "        with self.server_lock: ...\n"
+        "# or snapshot under one lock, then work under the other\n"
+        "# without nesting them at all"),
+    'NBK802': (
+        "def _worker(self):        # runs on N spawned threads\n"
+        "    self.inflight += 1    # torn read-modify-write",
+        "def _worker(self):\n"
+        "    with self._lock:      # one lock guards EVERY write\n"
+        "        self.inflight += 1"),
+    'NBK803': (
+        "with self._lock:\n"
+        "    resp = urllib.request.urlopen(url)   # fleet wedges\n"
+        "    self._update(resp)                   # behind the RTT",
+        "resp = urllib.request.urlopen(url)   # block OUTSIDE\n"
+        "with self._lock:\n"
+        "    self._update(resp)               # lock only the update"),
+    'NBK804': (
+        "self._lock.acquire()\n"
+        "self._flush()          # raises -> lock held forever\n"
+        "self._lock.release()",
+        "with self._lock:       # released on every exit path\n"
+        "    self._flush()\n"
+        "# (or try/finally with release() in the finally block)"),
+    'NBK805': (
+        "def _work():\n"
+        "    with span('serve.step'): ...   # orphaned span\n"
+        "threading.Thread(target=_work).start()",
+        "def _work():\n"
+        "    with trace_scope(ticket.ctx):  # carry the request\n"
+        "        with span('serve.step'): ...   # ctx across the hop\n"
+        "threading.Thread(target=_work).start()"),
 }
 
 

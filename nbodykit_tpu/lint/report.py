@@ -14,6 +14,7 @@ FAMILIES = collections.OrderedDict([
     ('NBK5', 'memory/donation'),
     ('NBK6', 'sharding-flow'),
     ('NBK7', 'precision-flow'),
+    ('NBK8', 'host-concurrency'),
     ('NBK0', 'tool'),
 ])
 

@@ -890,3 +890,79 @@ def i32_range_overflow(ctx):
                 'if bound > np.iinfo(np.int32).max: raise), or '
                 'bound the factors with module constants so the '
                 'range is provable')
+
+
+# ---------------------------------------------------------------------------
+# NBK8xx: host-concurrency (lock order, races, blocking under locks) —
+# thin wrappers over the interprocedural engine in concurrency.py
+
+
+@rule('NBK801', 'lock-order inversion across interprocedural paths')
+def lock_order_inversion(ctx):
+    """Two locks acquired in opposite orders on two different paths
+    is the textbook deadlock: thread A holds the router lock and
+    wants the server lock, thread B holds the server lock and wants
+    the router lock, and the fleet wedges with every worker parked.
+    The engine builds per-function held-sets, splices them through
+    call sites to fixpoint, and fires when both (a, b) and (b, a)
+    acquisition orders exist anywhere in the project — the host-side
+    sibling of NBK103's collective-order divergence."""
+    from .concurrency import find_lock_inversions
+    for node, message, hint in find_lock_inversions(ctx):
+        yield _finding('NBK801', ctx, node, message, hint)
+
+
+@rule('NBK802', 'shared mutable state written from multiple threads '
+                'with no common lock')
+def shared_state_race(ctx):
+    """A ``self.attr`` / module-global written from two or more
+    thread roots with no single lock held at every write is a data
+    race: torn updates, lost increments, and heisenbugs that only
+    fire under production interleavings.  Writes under a common lock
+    (the intersection of held-sets across all writes is non-empty)
+    are silent; ``__init__`` is excluded (the object is not yet
+    shared)."""
+    from .concurrency import find_shared_state_races
+    for node, message, hint in find_shared_state_races(ctx):
+        yield _finding('NBK802', ctx, node, message, hint)
+
+
+@rule('NBK803', 'blocking call while holding a lock')
+def blocking_under_lock(ctx):
+    """A blocking operation under a held lock turns one slow request
+    into a fleet-wide wedge: every thread that needs the lock parks
+    behind a network round-trip, an unbounded ``join()``/``wait()``,
+    a no-timeout queue op, a subprocess — or, worst of all, a JAX
+    collective, where the lock is now hostage to every *other* host
+    reaching the same collective.  Fires on the lexical site and on
+    calls whose interprocedural summary reaches a blocking
+    operation."""
+    from .concurrency import find_blocking_under_lock
+    for node, message, hint in find_blocking_under_lock(ctx):
+        yield _finding('NBK803', ctx, node, message, hint)
+
+
+@rule('NBK804', 'acquire() not released on the exception path')
+def unreleased_acquire(ctx):
+    """A bare ``lock.acquire()`` with no ``with`` block and no
+    try/finally ``release()`` leaks the lock the first time anything
+    between acquire and release raises — after which every other
+    thread deadlocks silently.  The ``with`` statement is the fix
+    and is always silent."""
+    from .concurrency import find_unreleased_acquires
+    for node, message, hint in find_unreleased_acquires(ctx):
+        yield _finding('NBK804', ctx, node, message, hint)
+
+
+@rule('NBK805', 'thread spawn drops the trace context')
+def context_dropping_spawn(ctx):
+    """``threading.Thread(target=f)`` where ``f`` transitively emits
+    ``span(...)`` but never enters ``trace_scope`` produces orphaned
+    spans: the work happens, the trace shows nothing, and the doctor
+    waterfall has a hole exactly where the bug is.  Propagate the
+    request context across the hop (``with trace_scope(ctx):`` in
+    the thread body) or emit out-of-band with
+    ``emit_span(..., ctx=...)``."""
+    from .concurrency import find_context_dropping_spawns
+    for node, message, hint in find_context_dropping_spawns(ctx):
+        yield _finding('NBK805', ctx, node, message, hint)

@@ -162,7 +162,8 @@ def _rules():
     if cached is not None and cached[0] == spec:
         return cached[1]
     rules = tuple(parse_spec(spec))
-    _parsed = (spec, rules)
+    with _lock:
+        _parsed = (spec, rules)
     return rules
 
 

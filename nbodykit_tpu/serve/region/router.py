@@ -173,57 +173,66 @@ class RegionRouter(object):
     def route(self, request):
         """The structured placement verdict for ``request`` (see the
         class docstring for the grammar).  Pure decision — nothing is
-        submitted here."""
+        submitted here.
+
+        The router lock covers only the membership/home snapshots and
+        the final home write: the ``_accepting``/``_depth`` probes go
+        to each fleet's ``AnalysisServer.load()`` (which takes the
+        server's own lock and, behind a dying fleet, can stall), and
+        holding the router lock across them would park every
+        concurrent submit — and the pacer's rehome — behind the
+        slowest fleet's health probe (NBK803)."""
         with self.lock:
             fleets = list(self._fleets)
-            homes = self._homes
-            n = len(fleets)
-            healthy = [f for f in fleets if self._accepting(f)]
-            if not healthy:
-                return {'code': 'no_fleet', 'fleets': n,
-                        'detail': 'no accepting fleet in the region'}
+            home = None
             path = None
             if getattr(request, 'data_ref', None) is not None:
                 path = request.data_ref.get('path')
-                home = homes.get(path)
-                if home is not None:
-                    for f in healthy:
-                        if f.name == home['fleet']:
-                            return {'code': 'catalog_home',
-                                    'fleet': f.name}
-                    # resident home is dead: fall through to the
-                    # affinity hash and re-home below
-            # the PR-13 placement idiom at fleet granularity: the
-            # ndevices argument is pinned to 1 so the hash keys
-            # content identity, not any one fleet's sub-mesh width
-            aff = fleets[affinity(request, 1, n)]
-            if not self._accepting(aff):
-                target = min(healthy, key=self._depth)
-                verdict = {'code': 'rerouted_dead',
-                           'fleet': target.name, 'from': aff.name,
-                           'detail': 'affinity fleet not accepting'}
-            else:
-                depth = self._depth(aff)
-                target = aff
-                verdict = {'code': 'affinity', 'fleet': aff.name,
-                           'depth': depth}
-                if depth > self.spill_depth:
-                    spill = min(healthy, key=self._depth)
-                    sdepth = self._depth(spill)
-                    if spill is not aff and sdepth < depth:
-                        target = spill
-                        verdict = {'code': 'spill',
-                                   'fleet': spill.name,
-                                   'from': aff.name,
-                                   'from_depth': depth,
-                                   'depth': sdepth,
-                                   'detail': 'affinity fleet over '
-                                             'spill depth %d'
-                                             % self.spill_depth}
-            if path is not None:
-                homes[path] = {'fleet': target.name,
-                               'salt': hash((path,))}
-            return verdict
+                home = dict(self._homes.get(path) or ())
+        n = len(fleets)
+        healthy = [f for f in fleets if self._accepting(f)]
+        if not healthy:
+            return {'code': 'no_fleet', 'fleets': n,
+                    'detail': 'no accepting fleet in the region'}
+        if home:
+            for f in healthy:
+                if f.name == home['fleet']:
+                    return {'code': 'catalog_home',
+                            'fleet': f.name}
+            # resident home is dead: fall through to the
+            # affinity hash and re-home below
+        # the PR-13 placement idiom at fleet granularity: the
+        # ndevices argument is pinned to 1 so the hash keys
+        # content identity, not any one fleet's sub-mesh width
+        aff = fleets[affinity(request, 1, n)]
+        if not self._accepting(aff):
+            target = min(healthy, key=self._depth)
+            verdict = {'code': 'rerouted_dead',
+                       'fleet': target.name, 'from': aff.name,
+                       'detail': 'affinity fleet not accepting'}
+        else:
+            depth = self._depth(aff)
+            target = aff
+            verdict = {'code': 'affinity', 'fleet': aff.name,
+                       'depth': depth}
+            if depth > self.spill_depth:
+                spill = min(healthy, key=self._depth)
+                sdepth = self._depth(spill)
+                if spill is not aff and sdepth < depth:
+                    target = spill
+                    verdict = {'code': 'spill',
+                               'fleet': spill.name,
+                               'from': aff.name,
+                               'from_depth': depth,
+                               'depth': sdepth,
+                               'detail': 'affinity fleet over '
+                                         'spill depth %d'
+                                         % self.spill_depth}
+        if path is not None:
+            with self.lock:
+                self._homes[path] = {'fleet': target.name,
+                                     'salt': hash((path,))}
+        return verdict
 
 
 class RegionTicket(object):
@@ -353,6 +362,23 @@ class Region(object):
                     and not pending[0].done.is_set():
                 return False
 
+    def _stop_pacer(self):
+        """Stop the QoS pacer thread and wait for it — idempotent by
+        contract, not convention: safe from ``drain`` + ``shutdown``
+        in either order, from two racing ``shutdown`` calls, and from
+        a pacer that already exited.  Anything still on the hold heap
+        comes back for a structured eviction (never silence)."""
+        with self._cv:
+            held = [t for _, _, t in self._held]
+            self._held = []
+            self._stop = True
+            self._cv.notify_all()
+        pacer = self._pacer
+        if pacer is not None and pacer.is_alive() and \
+                pacer is not threading.current_thread():
+            pacer.join(timeout=5.0)
+        return held
+
     def shutdown(self, drain=True, timeout=None, fleets=True):
         """Stop accepting, optionally drain, stop the pacer, and (by
         default) shut the member fleets down too.  Anything still
@@ -362,12 +388,7 @@ class Region(object):
             self._accepting = False
         if drain:
             self.drain(timeout=timeout)
-        with self._cv:
-            held = [t for _, _, t in self._held]
-            self._held = []
-            self._stop = True
-            self._cv.notify_all()
-        for t in held:
+        for t in self._stop_pacer():
             self._finish(t, RequestResult(
                 t.request.request_id, EVICTED,
                 reason={'code': 'shutdown',
@@ -375,7 +396,6 @@ class Region(object):
                                   'fair-share pacing'},
                 algorithm=t.request.algorithm,
                 shape_class=t.request.shape_class))
-        self._pacer.join(timeout=5.0)
         if fleets:
             for f in self.router.fleets():
                 f.server.shutdown(drain=drain, timeout=timeout)

@@ -177,17 +177,32 @@ def replay_region(region, items, interarrival_s=0.0, seed=0,
     rng = random.Random(seed)
     tickets = []
     done_submitting = threading.Event()
+    stop = threading.Event()
 
     def _harvest():
+        # bounded waits so a stop request is always honored within
+        # one poll interval, even mid-wait on a wedged ticket
         i = 0
-        while True:
+        while not stop.is_set():
             if i < len(tickets):
-                region.wait(tickets[i])
-                i += 1
+                region.wait(tickets[i], timeout=0.25)
+                if tickets[i].done.is_set():
+                    i += 1
             elif done_submitting.is_set():
                 return
             else:
                 time.sleep(0.005)
+
+    def _stop_harvester(drain):
+        # idempotent by contract: safe to call twice, safe after the
+        # harvester already exited, and the exception path (drain=
+        # False) never hangs the caller behind an undelivered verdict
+        done_submitting.set()
+        if not drain:
+            stop.set()
+        if harvester.is_alive() and \
+                harvester is not threading.current_thread():
+            harvester.join(None if drain else 2.0)
 
     harvester = threading.Thread(target=_harvest, daemon=True,
                                  name='region-replay-harvest')
@@ -202,7 +217,8 @@ def replay_region(region, items, interarrival_s=0.0, seed=0,
                                          tenant=item['tenant']))
             if interarrival_s > 0:
                 time.sleep(rng.expovariate(1.0 / interarrival_s))
-    finally:
-        done_submitting.set()
-        harvester.join()
+    except BaseException:
+        _stop_harvester(drain=False)
+        raise
+    _stop_harvester(drain=True)
     return tickets

@@ -1,0 +1,572 @@
+"""Tests for nbkl v4: the NBK8xx host-concurrency engine.
+
+Every rule gets at least one positive and one negative fixture — the
+negatives matter as much as the positives, because a concurrency
+linter that cries wolf gets pragma'd into silence.  The load-bearing
+regression at the bottom pins the permanent zero-findings budget: the
+repo's own threaded serve plane must stay NBK8xx-clean with ZERO
+baselined entries, forever — concurrency findings are fixed or
+explicitly pragma'd at the site, never grandfathered.
+
+Alongside the static fixtures: 50-iteration stress loops proving the
+two real shutdown races this engine's triage surfaced (the telemetry
+exporter's stop-without-join, and the region replay harvester's
+unbounded join on the exception path) stay fixed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from nbodykit_tpu import lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_str(src, select=('NBK8',)):
+    return lint.lint_source('fixture.py', textwrap.dedent(src),
+                            project_constants={'AXIS': 'dev'},
+                            select=list(select))
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# NBK801: lock-order inversion
+
+INVERSION = '''
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+    def f():
+        with A:
+            with B:
+                pass
+    def g():
+        with B:
+            with A:
+                pass
+'''
+
+
+def test_nbk801_same_module_inversion():
+    fs = lint_str(INVERSION)
+    # one witness per side of the inversion: the A->B path and the
+    # B->A path are each reported, so the fix is visible at both ends
+    assert codes(fs) == ['NBK801', 'NBK801']
+    assert 'opposite order' in fs[0].message or \
+        'inversion' in fs[0].message.lower()
+
+
+def test_nbk801_consistent_order_is_clean():
+    fs = lint_str('''
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def f():
+            with A:
+                with B:
+                    pass
+        def g():
+            with A:
+                with B:
+                    pass
+    ''')
+    assert fs == []
+
+
+def test_nbk801_interprocedural_across_two_modules(tmp_path):
+    # the A-side of the inversion only exists through a call: outer()
+    # holds A and calls inner_b() which takes B — the engine must
+    # splice inner_b's acquire summary through the call site, and the
+    # B->A order lives in a DIFFERENT module importing both locks
+    (tmp_path / 'm1.py').write_text(textwrap.dedent('''
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def outer():
+            with A:
+                inner_b()
+        def inner_b():
+            with B:
+                pass
+    '''))
+    (tmp_path / 'm2.py').write_text(textwrap.dedent('''
+        from m1 import A, B
+        def rev():
+            with B:
+                with A:
+                    pass
+    '''))
+    new, grandfathered, _ = lint.run_lint([str(tmp_path)],
+                                          select=['NBK8'])
+    assert grandfathered == []
+    got = sorted((f.code, os.path.basename(f.path)) for f in new)
+    assert got == [('NBK801', 'm1.py'), ('NBK801', 'm2.py')]
+
+
+# ---------------------------------------------------------------------------
+# NBK802: shared mutable state from >= 2 thread roots, no common lock
+
+def test_nbk802_two_thread_writers_without_lock():
+    fs = lint_str('''
+        import threading
+        class S:
+            def __init__(self):
+                self.n = 0
+                threading.Thread(target=self.w1).start()
+                threading.Thread(target=self.w2).start()
+            def w1(self):
+                self.n += 1
+            def w2(self):
+                self.n -= 1
+    ''')
+    assert codes(fs) == ['NBK802']
+    assert 'S.n' in fs[0].message
+
+
+def test_nbk802_common_lock_is_clean():
+    fs = lint_str('''
+        import threading
+        class S:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self.w1).start()
+                threading.Thread(target=self.w2).start()
+            def w1(self):
+                with self.lock:
+                    self.n += 1
+            def w2(self):
+                with self.lock:
+                    self.n -= 1
+    ''')
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# NBK803: blocking while holding a lock
+
+def test_nbk803_join_and_collective_under_lock():
+    fs = lint_str('''
+        import threading
+        import jax
+        L = threading.Lock()
+        def f(t):
+            with L:
+                t.join()
+        def g(x):
+            with L:
+                return jax.lax.psum(x, AXIS)
+    ''')
+    assert codes(fs) == ['NBK803', 'NBK803']
+    blob = ' '.join(f.message for f in fs)
+    assert 'join()' in blob and 'collective' in blob
+
+
+def test_nbk803_collective_reached_through_call_chain(tmp_path):
+    # the callee's psum is not under any lock *locally* — it becomes
+    # blocking-under-lock only through the caller's locked call site
+    (tmp_path / 'c1.py').write_text(textwrap.dedent('''
+        import threading
+        import jax
+        L = threading.Lock()
+        def reduce_it(x):
+            return jax.lax.psum(x, 'dev')
+        def f(x):
+            with L:
+                return reduce_it(x)
+    '''))
+    new, _, _ = lint.run_lint([str(tmp_path)], select=['NBK8'])
+    assert codes(new) == ['NBK803']
+    assert 'collective' in new[0].message
+
+
+def test_nbk803_timeout_or_unlocked_is_clean():
+    fs = lint_str('''
+        import threading
+        L = threading.Lock()
+        def f(t):
+            with L:
+                t.join(timeout=1.0)
+            t.join()
+    ''')
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# NBK804: acquire() not released on the exception path
+
+def test_nbk804_bare_acquire_without_try_finally():
+    fs = lint_str('''
+        import threading
+        L = threading.Lock()
+        def f():
+            L.acquire()
+            g()
+            L.release()
+        def g():
+            pass
+    ''')
+    assert codes(fs) == ['NBK804']
+
+
+def test_nbk804_with_statement_is_clean():
+    fs = lint_str('''
+        import threading
+        L = threading.Lock()
+        def f():
+            with L:
+                pass
+    ''')
+    assert fs == []
+
+
+def test_nbk804_try_finally_release_is_clean():
+    fs = lint_str('''
+        import threading
+        L = threading.Lock()
+        def f():
+            L.acquire()
+            try:
+                pass
+            finally:
+                L.release()
+    ''')
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# NBK805: thread spawn that drops the trace context
+
+def test_nbk805_spawn_reaching_span_without_scope():
+    fs = lint_str('''
+        import threading
+        from nbodykit_tpu.diagnostics import span
+        def work():
+            with span('x'):
+                pass
+        def main():
+            threading.Thread(target=work).start()
+    ''')
+    assert codes(fs) == ['NBK805']
+    assert 'trace_scope' in fs[0].hint or 'trace_scope' in fs[0].message
+
+
+def test_nbk805_trace_scope_in_target_is_clean():
+    fs = lint_str('''
+        import threading
+        from nbodykit_tpu.diagnostics import span, trace_scope
+        def work():
+            with trace_scope(None):
+                with span('x'):
+                    pass
+        def main():
+            threading.Thread(target=work).start()
+    ''')
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# the seeded inversion through BOTH gates: the CLI subprocess and the
+# programmatic pytest gate
+
+def test_cli_subprocess_detects_seeded_inversion(tmp_path):
+    fixture = tmp_path / 'seeded.py'
+    fixture.write_text(textwrap.dedent(INVERSION))
+    proc = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', '--select',
+         'NBK8', str(fixture)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert 'NBK801' in proc.stdout
+    # baseline round-trip: grandfathering the seeded finding makes
+    # the exit clean again (the mechanism the repo deliberately does
+    # NOT use for NBK8xx — see the zero-budget test below)
+    base = tmp_path / 'base.json'
+    wb = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', '--select',
+         'NBK8', '--write-baseline', str(base), str(fixture)],
+        cwd=REPO, capture_output=True, text=True)
+    assert wb.returncode == 0, wb.stdout + wb.stderr
+    again = subprocess.run(
+        [sys.executable, '-m', 'nbodykit_tpu.lint', '--select',
+         'NBK8', '--baseline', str(base), str(fixture)],
+        cwd=REPO, capture_output=True, text=True)
+    assert again.returncode == 0, again.stdout + again.stderr
+
+
+def test_pytest_gate_detects_seeded_inversion(tmp_path):
+    (tmp_path / 'seeded.py').write_text(textwrap.dedent(INVERSION))
+    new, _, _ = lint.run_lint([str(tmp_path)], select=['NBK8'])
+    assert 'NBK801' in codes(new)
+
+
+# ---------------------------------------------------------------------------
+# the reports
+
+def test_lock_report_rows_and_rendering(tmp_path):
+    (tmp_path / 'svc.py').write_text(textwrap.dedent('''
+        import threading
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                threading.Thread(target=self.worker,
+                                 name='svc-worker').start()
+            def worker(self):
+                with self._cv:
+                    self._cv.notify_all()
+            def poke(self):
+                with self._lock:
+                    pass
+    '''))
+    project, parse_findings = lint.build_project([str(tmp_path)])
+    assert parse_findings == []
+    rows = lint.lock_report(project)
+    assert len(rows) == 1
+    row = rows[0]
+    # the Condition collapses onto the lock it wraps — one identity,
+    # with the alias on record
+    assert row['lock'].endswith('svc.Server._lock')
+    assert row['kind'] == 'lock'
+    assert [a.endswith('svc.Server._cv') for a in row['aliases']] \
+        == [True]
+    assert 'thread:svc-worker' in row['threads']
+    assert 'main' in row['threads']
+    assert row['acquire_sites'] == 2
+    text = lint.render_lock_report(rows)
+    assert 'svc.Server._lock' in text
+    assert 'aliased by' in text
+    assert 'thread:svc-worker' in text
+
+
+def test_threads_report_rows(tmp_path):
+    (tmp_path / 'svc.py').write_text(textwrap.dedent('''
+        import threading
+        def helper():
+            pass
+        def worker():
+            helper()
+        def main():
+            threading.Thread(target=worker,
+                             name='bg-worker').start()
+    '''))
+    project, _ = lint.build_project([str(tmp_path)])
+    rows = lint.threads_report(project)
+    assert [r['root'] for r in rows] == ['thread:bg-worker']
+    assert rows[0]['target'] == 'worker'
+    # reach is transitive: the root covers the helper too
+    assert set(rows[0]['reaches']) == {'worker', 'helper'}
+    text = lint.render_threads_report(rows)
+    assert 'thread:bg-worker' in text and 'worker()' in text
+
+
+def test_cli_lock_report_runs_on_repo_tree(tmp_path, capsys):
+    # the acceptance bar: every lock in the threaded serve plane
+    # shows up with its acquiring threads
+    rows = lint.run_lock_report([os.path.join(REPO, 'nbodykit_tpu')])
+    out = capsys.readouterr().out
+    names = {r['lock'] for r in rows}
+    for expected in ('serve.server.AnalysisServer._lock',
+                     'serve.region.router.Region._lock',
+                     'diagnostics.trace.Tracer._wlock',
+                     'resilience.faults._lock'):
+        assert any(n.endswith(expected) for n in names), \
+            (expected, sorted(names))
+    # the serve-plane locks are touched by worker threads, not just
+    # the submitting main thread
+    region = [r for r in rows
+              if r['lock'].endswith('region.router.Region._lock')][0]
+    assert any(t.startswith('thread:') for t in region['threads'])
+    assert 'host-concurrency lock report' in out
+
+
+# ---------------------------------------------------------------------------
+# the permanent zero-findings budget
+
+def test_repo_tree_nbk8_budget_is_zero_forever():
+    """The threaded serve plane stays NBK8xx-clean with ZERO baselined
+    entries: a concurrency finding is fixed or pragma'd at the site
+    with its justification, never grandfathered into the baseline."""
+    baseline = os.path.join(REPO, 'lint_baseline.json')
+    new, grandfathered, _ = lint.run_lint(
+        lint.default_targets(REPO), baseline_path=baseline,
+        select=['NBK8'])
+    assert new == [], lint.render_findings(new)
+    assert grandfathered == []
+    doc = json.load(open(baseline))
+    nbk8 = [e for e in doc.get('findings', ())
+            if str(e.get('code', '')).startswith('NBK8')]
+    assert nbk8 == []
+
+
+def test_stats_has_host_concurrency_family_axis():
+    # regress.py records family_stats into BENCH_HISTORY.json; the
+    # NBK8 axis must exist (zeroed) even with no findings, so the
+    # history gains the column the smoke gate reads
+    from nbodykit_tpu.lint.report import FAMILIES, family_stats
+    assert FAMILIES.get('NBK8') == 'host-concurrency'
+    fams = family_stats([], [])
+    assert fams['NBK8'] == {'new': 0, 'baselined': 0}
+
+
+def test_explain_covers_all_five_codes():
+    from nbodykit_tpu.lint.explain import EXAMPLES
+    from nbodykit_tpu.lint.rules import RULES
+    for code in ('NBK801', 'NBK802', 'NBK803', 'NBK804', 'NBK805'):
+        assert code in RULES
+        bad, good = EXAMPLES[code]
+        assert bad.strip() and good.strip()
+
+
+def test_pragma_suppresses_nbk8(tmp_path):
+    fs = lint_str('''
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def f():
+            with A:
+                with B:  # nbkl: disable=NBK801 -- fixture
+                    pass
+        def g():
+            with B:
+                with A:  # nbkl: disable=NBK801 -- fixture
+                    pass
+    ''')
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# doctor cross-link #3: the concurrency verdict line
+
+def test_doctor_concurrency_ok_line(tmp_path, capsys):
+    import shutil
+    root = str(tmp_path)
+    os.symlink(os.path.join(REPO, 'nbodykit_tpu'),
+               os.path.join(root, 'nbodykit_tpu'))
+    shutil.copy(os.path.join(REPO, 'lint_baseline.json'),
+                os.path.join(root, 'lint_baseline.json'))
+    from nbodykit_tpu.diagnostics import REGISTRY
+    from nbodykit_tpu.diagnostics.__main__ import run_doctor
+    try:
+        rc = run_doctor(trace=None, root=root)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert 'concurrency  OK: 0 open NBK8xx findings' in out
+    finally:
+        REGISTRY.reset()
+
+
+def test_doctor_concurrency_warn_line_on_open_finding(tmp_path,
+                                                      capsys):
+    # a root whose package is just the seeded inversion: the doctor
+    # must print the static finding on its own concurrency line, next
+    # to (absent here) runtime wedge evidence
+    root = str(tmp_path)
+    pkg = os.path.join(root, 'nbodykit_tpu')
+    os.makedirs(pkg)
+    with open(os.path.join(pkg, 'seeded.py'), 'w') as f:
+        f.write(textwrap.dedent(INVERSION))
+    from nbodykit_tpu.diagnostics import REGISTRY
+    from nbodykit_tpu.diagnostics.__main__ import run_doctor
+    try:
+        rc = run_doctor(trace=None, root=root)
+        out = capsys.readouterr().out
+        assert rc != 0
+        assert 'lint         FAIL' in out
+        assert 'concurrency  WARN' in out
+        assert 'NBK801' in out and 'seeded.py' in out
+    finally:
+        REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# the shutdown races this engine's triage surfaced, pinned by stress
+
+def test_exporter_stop_joins_serving_thread_stress():
+    """stop() is a contract: when it returns, the serving thread is
+    gone and the port is closed.  Before the join was added, this
+    loop flaked — the successor exporter raced the half-dead
+    predecessor for the socket."""
+    from nbodykit_tpu.diagnostics.export import TelemetryExporter
+    for _ in range(50):
+        exp = TelemetryExporter(port=0)
+        t = exp._thread
+        exp.stop()
+        assert not t.is_alive()
+        exp.stop()              # idempotent: double stop is a no-op
+
+
+class _StubTicket(object):
+    def __init__(self, request):
+        self.request = request
+        self.done = threading.Event()
+        self.done.set()
+
+
+class _StubServer(object):
+    ndevices = 1
+    meshes = [None]
+
+    def load(self):
+        return {'queued': 0, 'inflight': 0, 'accepting': True,
+                'workers': 1}
+
+    def submit(self, request):
+        return _StubTicket(request)
+
+    def wait(self, ticket, timeout=None):
+        return None
+
+    def shutdown(self, drain=True, timeout=None):
+        pass
+
+
+def test_region_stop_pacer_idempotent_stress():
+    """shutdown() joins the pacer and is safe to call repeatedly —
+    before _stop_pacer was made idempotent, a drain()+shutdown()
+    sequence could double-finish held tickets or leave the pacer
+    running past shutdown's return."""
+    from nbodykit_tpu.serve import Region
+    for _ in range(50):
+        region = Region([('a', _StubServer())])
+        region.shutdown()
+        assert not region._pacer.is_alive()
+        region.shutdown()       # second shutdown: no raise, no hang
+        assert region._stop_pacer() == []
+
+
+def test_replay_region_exception_path_stops_harvester_stress():
+    """An exception mid-submission must propagate promptly — the old
+    finally-join waited on the harvester, which waited forever on the
+    wedged ticket the exception left behind."""
+    from nbodykit_tpu.serve.synth import replay_region
+
+    class _WedgedTicket(object):
+        def __init__(self):
+            self.done = threading.Event()   # never set: wedged
+
+    class _WedgedRegion(object):
+        def submit(self, request, tenant='default'):
+            return _WedgedTicket()
+
+        def wait(self, ticket, timeout=None):
+            return None
+
+    for _ in range(50):
+        def items():
+            yield {'tenant': 'a', 'request': object()}
+            raise RuntimeError('boom')
+        with pytest.raises(RuntimeError, match='boom'):
+            replay_region(_WedgedRegion(), items())
+        assert not [t for t in threading.enumerate()
+                    if t.name == 'region-replay-harvest'
+                    and t.is_alive()]

@@ -73,12 +73,12 @@ def _report(tracedir):
     return request_report(procs)
 
 
-def _req(i, seed=None, **kw):
+def _req(i, seed=None, prefix='obs', **kw):
     kw.setdefault('nmesh', 16)
     kw.setdefault('npart', 1000)
     kw.setdefault('deadline_s', 120.0)
     return AnalysisRequest(seed=seed if seed is not None else 100 + i,
-                           request_id='obs-%03d' % i, **kw)
+                           request_id='%s-%03d' % (prefix, i), **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -351,18 +351,21 @@ def test_region_slo_and_flight_record_terminal_verdicts(tmp_path):
         classes=[ServiceClass('interactive'),
                  ServiceClass('bulk', rate=1.0, burst=1)],
         tenants={'sweep': 'bulk'}, default_class='interactive')
-    n0 = len(FLIGHT)
     with nbodykit_tpu.set_options(diagnostics=str(tmp_path)):
         region = _region(str(tmp_path), qos=qos)
-        ok = region.wait(region.submit(_req(0, seed=3)), timeout=180)
+        ok = region.wait(region.submit(_req(0, seed=3, prefix='obs-flt')),
+                         timeout=180)
         # warm consumes the burst token so the tight-deadline pair
         # below cannot slip through and die a (burning) deadline death
-        warm = region.submit(_req(1, seed=4), tenant='sweep')
+        warm = region.submit(_req(1, seed=4, prefix='obs-flt'),
+                             tenant='sweep')
         # due-time past the deadline -> qos_throttled eviction, which
         # must shed (never burn the availability budget)
-        t1 = region.submit(_req(2, seed=5, deadline_s=0.05),
+        t1 = region.submit(_req(2, seed=5, deadline_s=0.05,
+                                prefix='obs-flt'),
                            tenant='sweep')
-        t2 = region.submit(_req(3, seed=6, deadline_s=0.05),
+        t2 = region.submit(_req(3, seed=6, deadline_s=0.05,
+                                prefix='obs-flt'),
                            tenant='sweep')
         shed = [region.wait(t1, timeout=60),
                 region.wait(t2, timeout=60)]
@@ -376,10 +379,12 @@ def test_region_slo_and_flight_record_terminal_verdicts(tmp_path):
     assert slo['verdict'] == 'OK'   # shedding is not failure
     bulk = slo['classes']['bulk']
     assert bulk['shed'] == 2 and bulk['bad'] == 0
-    # the region (context owner) recorded every terminal verdict
-    entries = FLIGHT.snapshot()[n0:]
-    mine = [e for e in entries
-            if (e.get('request_id') or '').startswith('obs-')]
+    # the region (context owner) recorded every terminal verdict.
+    # FLIGHT is a bounded ring: once an earlier test fills it to
+    # maxlen, appends rotate instead of growing and a len()-based
+    # slice sees nothing -- select by this test's unique id prefix.
+    mine = [e for e in FLIGHT.snapshot()
+            if (e.get('request_id') or '').startswith('obs-flt-')]
     assert len(mine) >= 4
     assert {e['layer'] for e in mine} == {'region'}
 
