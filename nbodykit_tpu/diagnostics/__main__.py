@@ -780,6 +780,51 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                 lines.append('ingest       OK: %s' % desc)
 
     if root is not None:
+        # forward-model posture: the latest committed forward round
+        # (bench.py --forward, docs/FORWARD.md).  The hard failure is
+        # a violated finite-difference gradient check — a forward
+        # model whose deployed gradient is wrong poisons every
+        # inference sample built on it, however fast it runs.  A
+        # recovery that does not beat the classical FFTRecon baseline
+        # WARNs: the pipeline is differentiable but the inference
+        # configuration is not earning its keep.
+        from .regress import forward_summary
+        fwd = forward_summary(root)
+        if fwd is None:
+            lines.append('forward      SKIP: no forward record in any '
+                         'committed bench round')
+        elif 'error' in fwd:
+            warn.append('forward')
+            lines.append('forward      WARN: forward summary '
+                         'unavailable (%s)' % fwd['error'])
+        else:
+            desc = ('mesh%s/part%s x%s steps, %s paint (%s adjoint); '
+                    'grad %ss = x%s forward; recovery r=%s vs '
+                    'FFTRecon r=%s'
+                    % (fwd.get('nmesh', '?'), fwd.get('npart', '?'),
+                       fwd.get('pm_steps', '?'),
+                       fwd.get('paint_method', '?'),
+                       fwd.get('adjoint_mode', '?'),
+                       fwd.get('grad_s', '?'),
+                       fwd.get('grad_overhead', '?'),
+                       fwd.get('r_recovered', '?'),
+                       fwd.get('r_fftrecon', '?')))
+            if fwd.get('grad_check_ok') is False:
+                fail.append('forward')
+                lines.append('forward      FAIL: finite-difference '
+                             'gradient check VIOLATED (rel err %s) — '
+                             'the deployed forward model is not '
+                             'differentiable (%s)'
+                             % (fwd.get('grad_rel_err', '?'), desc))
+            elif fwd.get('beats_baseline') is False:
+                warn.append('forward')
+                lines.append('forward      WARN: gradient recovery '
+                             'does NOT beat the FFTRecon baseline '
+                             '(%s)' % desc)
+            else:
+                lines.append('forward      OK: %s' % desc)
+
+    if root is not None:
         # integrity posture: tripwire violations caught vs retried
         # clean, the shadow-verification ledger, and quarantined
         # ranks.  The ONE hard failure is an unacknowledged shadow

@@ -447,6 +447,55 @@ def ingest_summary(root):
     return latest
 
 
+def forward_summary(root):
+    """Forward-model posture for the round record: the latest
+    committed ``forward_*`` bench record (``bench.py --forward``,
+    docs/FORWARD.md) reduced to the numbers the doctor judges —
+    backward/forward overhead, the finite-difference gradient check
+    (``grad_check_ok`` False is a FAIL verdict: a forward model with a
+    wrong gradient is not differentiable, however fast), and the
+    recovery-vs-FFTRecon cross-correlations (``beats_baseline`` False
+    is a FAIL: the gradient exists to beat the classical estimator).
+    ``None`` when no round carries a forward record; never raises."""
+    latest = None
+    try:
+        for pattern in ROUND_GLOBS:
+            for path in sorted(glob.glob(os.path.join(root, pattern)),
+                               key=_round_key):
+                try:
+                    with open(path) as f:
+                        rec = json.load(f).get('parsed') or {}
+                except (OSError, ValueError):
+                    continue
+                metric = str(rec.get('metric', ''))
+                if not metric.startswith('forward'):
+                    continue
+                check = rec.get('grad_check') or {}
+                recov = rec.get('recovery') or {}
+                latest = {
+                    'round': os.path.basename(path),
+                    'metric': metric,
+                    'nmesh': rec.get('nmesh'),
+                    'npart': rec.get('npart'),
+                    'pm_steps': rec.get('pm_steps'),
+                    'paint_method': rec.get('paint_method'),
+                    'adjoint_mode': rec.get('adjoint_mode'),
+                    'forward_s': rec.get('forward_s'),
+                    'grad_s': rec.get('grad_s'),
+                    'grad_overhead': rec.get('grad_overhead'),
+                    'grad_check_ok': rec.get('grad_check_ok'),
+                    'grad_rel_err': check.get('rel_err'),
+                    'r_recovered': recov.get('r_recovered'),
+                    'r_fftrecon': recov.get('r_fftrecon'),
+                    'beats_baseline': recov.get('beats_baseline'),
+                    'grad_residual_bytes':
+                        rec.get('grad_residual_bytes'),
+                }
+    except Exception as e:      # pragma: no cover - defensive
+        return {'error': str(e)}
+    return latest
+
+
 def region_summary(root):
     """Region posture for the round record: the latest committed
     ``regiontrace_*`` bench record (``bench.py --region-trace``, the
@@ -772,6 +821,7 @@ def build_history(root='.', out=None, threshold=0.25, stale_hours=24.0,
         'serve': serve_summary(root),
         'region': region_summary(root),
         'ingest': ingest_summary(root),
+        'forward': forward_summary(root),
         'integrity': integrity_summary(root),
         'slo': slo_summary(root),
         'precision': precision_summary(root, now=now),
@@ -932,6 +982,35 @@ def render_regress(history):
               '%s GB/s cache-hit%s'
               % (ing.get('rows', '?'), ing.get('cold_gbs', '?'),
                  ing.get('warm_gbs', '?'),
+                 ' — %s' % '; '.join(bits) if bits else ''))
+    fwd = history.get('forward')
+    if fwd is not None:
+        if 'error' in fwd:
+            w('  forward: unavailable (%s)' % fwd['error'])
+        else:
+            bits = []
+            if fwd.get('grad_check_ok') is False:
+                bits.append('FAIL — gradient check VIOLATED (FD rel '
+                            'err %s): the forward model is not '
+                            'differentiable as deployed'
+                            % fwd.get('grad_rel_err', '?'))
+            if fwd.get('beats_baseline') is False:
+                bits.append('FAIL — recovery r=%s does NOT beat the '
+                            'FFTRecon baseline r=%s'
+                            % (fwd.get('r_recovered', '?'),
+                               fwd.get('r_fftrecon', '?')))
+            w('  forward: mesh%s/part%s x%s steps (%s paint, %s '
+              'adjoint) — grad %ss (x%s over forward), FD check '
+              '%s; recovery r=%s vs FFTRecon r=%s%s'
+              % (fwd.get('nmesh', '?'), fwd.get('npart', '?'),
+                 fwd.get('pm_steps', '?'),
+                 fwd.get('paint_method', '?'),
+                 fwd.get('adjoint_mode', '?'),
+                 fwd.get('grad_s', '?'),
+                 fwd.get('grad_overhead', '?'),
+                 'ok' if fwd.get('grad_check_ok') else 'VIOLATED',
+                 fwd.get('r_recovered', '?'),
+                 fwd.get('r_fftrecon', '?'),
                  ' — %s' % '; '.join(bits) if bits else ''))
     integ = history.get('integrity')
     if integ is not None:

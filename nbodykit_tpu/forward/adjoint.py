@@ -1,0 +1,137 @@
+"""Grad-safe paint: the adjoint contract per paint kernel.
+
+The compensated paint/readout pair is an adjoint pair — the VJP of
+scatter-add IS readout — so the backward pass of painting needs no new
+kernels.  What differs per tuned paint method is whether JAX's native
+reverse mode can trace the FORWARD:
+
+  scatter          natively differentiable (.at[].add has a transpose
+                   rule; the halo exchange is psum/ppermute, also
+                   transposable).  Used as-is.
+  sort / segsum /  forward is fine under jit but reverse mode either
+  streams          fails to trace (sort's while_loop) or materializes
+                   absurd residuals.  Wrapped in ``jax.custom_vjp``:
+                   winner kernel forward, analytic readout backward.
+  mxu              its traced overflow contract requires
+                   return_dropped, which cannot live inside a silent
+                   custom_vjp forward — demoted via
+                   ``resolve_paint(differentiable=True)`` (source tag
+                   'grad-fallback', counter ``tune.grad_fallback``).
+
+The analytic backward, for out = paint(pos, mass) and cotangent g:
+
+  d/dmass  = readout(g, pos)                       (the classic adjoint)
+  d/dpos_d = mass * readout(g, pos, grad_axis=d) * Nmesh_d / Box_d
+
+where ``grad_axis`` readout uses the derivative window dW/dx (cell
+units, ops/window.py window_weights_grad), hence the Nmesh/Box factor
+to return box-unit gradients.  window_weights_grad matches the a.e.
+derivative of the native path, so both modes agree wherever defined —
+asserted against finite differences in tests/test_forward.py.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import option_scope
+from ..tune.resolve import (resolve_paint, DIFFERENTIABLE_PAINT,
+                            GRAD_WRAPPED_PAINT)
+
+
+def resolve_forward_paint(pm, npart):
+    """Tuned paint config for a grad workload plus its adjoint mode.
+
+    Returns (cfg, mode) with mode in {'native', 'custom_vjp'}:
+    'native' lets JAX reverse mode trace the kernel, 'custom_vjp'
+    means :func:`make_paint` installs the analytic readout backward.
+    Cached winners without either story demote through the resolver's
+    grad fallback (never a trace error deep inside ``jax.grad``).
+    """
+    kw = dict(nmesh=int(pm.Nmesh[0]), npart=int(npart),
+              dtype=str(np.dtype(pm.dtype)), nproc=pm.nproc)
+    cfg = resolve_paint(**kw)
+    method = cfg.get('paint_method', 'scatter')
+    if method in DIFFERENTIABLE_PAINT:
+        return cfg, 'native'
+    if method in GRAD_WRAPPED_PAINT:
+        return cfg, 'custom_vjp'
+    # mxu or unknown: ask the resolver for the grad-mode fallback.
+    cfg = resolve_paint(differentiable=True, **kw)
+    return cfg, 'native'
+
+
+def make_paint(pm, npart, resampler='cic', method=None):
+    """Build a differentiable ``paint(pos, mass=1.0) -> mesh`` over
+    ``pm`` for ``npart`` particles, pinned to the tuned kernel.
+
+    The resolved paint options are captured eagerly and re-applied via
+    ``option_scope`` around every call, so resolution inside a
+    ``jax.grad``/``jit`` trace is deterministic regardless of ambient
+    options.  Returns (paint_fn, cfg); cfg['adjoint_mode'] records the
+    contract chosen by :func:`resolve_forward_paint`.
+
+    ``method`` pins a specific paint kernel instead of consulting the
+    tuner (tests use this to exercise the custom_vjp path directly);
+    a method with no adjoint story ('mxu') is a ValueError here —
+    only the RESOLVER may silently demote.
+    """
+    if method is not None:
+        cfg = dict(resolve_paint(nmesh=int(pm.Nmesh[0]),
+                                 npart=int(npart),
+                                 dtype=str(np.dtype(pm.dtype)),
+                                 nproc=pm.nproc),
+                   paint_method=method, source='explicit')
+        if method in DIFFERENTIABLE_PAINT:
+            mode = 'native'
+        elif method in GRAD_WRAPPED_PAINT:
+            mode = 'custom_vjp'
+        else:
+            raise ValueError(
+                "paint method %r has no adjoint contract; use the "
+                "resolver (method=None) for the grad fallback" % method)
+    else:
+        cfg, mode = resolve_forward_paint(pm, npart)
+    cfg = dict(cfg, adjoint_mode=mode)
+    opts = {k: cfg[k] for k in
+            ('paint_method', 'paint_chunk_size', 'paint_streams')
+            if k in cfg and cfg[k] is not None}
+    cdt = jnp.dtype(pm.compute_dtype)
+
+    def _run(pos, mass):
+        with option_scope(**opts):
+            return pm.paint(pos, mass, resampler=resampler)
+
+    if mode == 'native':
+        def paint_fn(pos, mass=1.0):
+            return _run(pos, jnp.broadcast_to(
+                jnp.asarray(mass, cdt), pos.shape[:1]))
+        return paint_fn, cfg
+
+    # box-units -> cell-units position gradient scale, per axis
+    scale = jnp.asarray(np.asarray(pm.Nmesh, 'f8')
+                        / np.asarray(pm.BoxSize, 'f8'), cdt)
+
+    @jax.custom_vjp
+    def _painted(pos, mass):
+        return _run(pos, mass)
+
+    def _fwd(pos, mass):
+        return _run(pos, mass), (pos, mass)
+
+    def _bwd(res, cot):
+        pos, mass = res
+        g = cot.astype(cdt)
+        dmass = pm.readout(g, pos, resampler=resampler)
+        dpos = jnp.stack(
+            [pm.readout(g, pos, resampler=resampler, grad_axis=d)
+             * scale[d] for d in range(3)], axis=-1)
+        dpos = dpos * mass[:, None]
+        return dpos.astype(pos.dtype), dmass.astype(mass.dtype)
+
+    _painted.defvjp(_fwd, _bwd)
+
+    def paint_fn(pos, mass=1.0):
+        return _painted(pos, jnp.broadcast_to(
+            jnp.asarray(mass, cdt), pos.shape[:1]))
+    return paint_fn, cfg

@@ -1,0 +1,130 @@
+"""Lagrangian perturbation theory initial conditions (ZA + 2LPT).
+
+Generates particle positions and momenta at a starting scale factor
+from the linear density modes, via the spectral displacement recipe the
+mockmaker already uses (gradient of the inverse Laplacian through
+``dist_rfftn``):
+
+  ZA:    psi1_i(k) = i k_i / k^2 * delta_k
+  2LPT:  S2 = sum_{i<j} [phi_{,ii} phi_{,jj} - phi_{,ij}^2],
+         phi_{,ij}(k) = k_i k_j / k^2 * delta_k,
+         psi2_i(k) = i k_i / k^2 * S2(k)
+
+with Einstein-de-Sitter growth (Omega_m = 1, the gauge the KDK stepper
+in pm.py integrates):
+
+  x(q, a) = q + D1 psi1 + D2 psi2,    D1 = a,  D2 = -(3/7) a^2
+  p(q, a) = a^{3/2} (dD1/dlna psi1 + dD2/dlna psi2) / a^{1/2}
+          = a^{3/2} (psi1 - (6/7) a psi2)
+
+where the momentum convention p = a^2 dx/dt (t in units with H0 = 1)
+matches the stepper's kick/drift factors — at linear order the
+Zel'dovich flow is an EXACT solution of the discrete KDK operators up
+to the O(da^3) integrator error, which is what the asymptotics test in
+tests/test_forward.py checks.
+
+Every function is jit-pure and differentiable with respect to the
+modes; particles live on the mesh lattice (one per cell, shift=0) so
+psi-at-particle is a raster reshape — no readout, no interpolation
+error in the ICs, and reverse mode through them is a reshape too.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _k_inv_k2(pm):
+    """k-vectors and the zero-safe 1/k^2 on the transposed complex
+    layout, in the mesh compute dtype."""
+    kx, ky, kz = pm.k_list()
+    k2 = kx ** 2 + ky ** 2 + kz ** 2
+    inv = jnp.where(k2 == 0, 0.0, 1.0 / jnp.where(k2 == 0, 1.0, k2))
+    return (kx, ky, kz), inv
+
+
+def linear_amplitude(pm, linear_power):
+    """sqrt(P(k)/V) on the complex mesh — the scaling that turns a
+    unit-variance hermitian whitenoise field into linear density modes
+    (mockmaker recipe, mockmaker.py gaussian_complex_fields).
+
+    ``linear_power`` is P(k) in box units, callable on |k|.  The DC
+    mode is zeroed (and P is never evaluated at k=0, so power laws
+    with negative spectral index are safe).
+    """
+    kx, ky, kz = pm.k_list()
+    k2 = kx ** 2 + ky ** 2 + kz ** 2
+    kmag = jnp.sqrt(jnp.where(k2 == 0, 1.0, k2))
+    V = float(np.prod(pm.BoxSize))
+    power = jnp.where(k2 == 0, 0.0, linear_power(kmag))
+    return jnp.sqrt(jnp.maximum(power, 0.0) / V)
+
+
+def linear_modes(pm, linear_power, seed):
+    """Gaussian linear density modes delta_k for a power spectrum —
+    ``generate_whitenoise`` scaled by :func:`linear_amplitude`.
+    Device-count invariant (the whitenoise draw is a function of
+    (seed, global cell index) only)."""
+    eta = pm.generate_whitenoise(seed)
+    return eta * linear_amplitude(pm, linear_power)
+
+
+def modes_from_white(pm, white, amp):
+    """Differentiable map from a REAL whitenoise field (the inference
+    parametrization, one real number per mesh cell) to linear modes.
+
+    ``pm.r2c`` is forward-normalized (divides by Ntot); multiplying by
+    sqrt(Ntot) restores unit variance per complex mode so ``amp``
+    (from :func:`linear_amplitude`) gives the same mode statistics as
+    :func:`linear_modes`.  Parametrizing by a real field keeps the
+    optimization leaf real-valued — no Wirtinger bookkeeping in
+    jax.grad — and the prior is an iid unit normal on the leaf.
+    """
+    return pm.r2c(white) * np.sqrt(pm.Ntot) * amp
+
+
+def lpt_displacements(pm, delta_k, order=2):
+    """ZA (and optionally 2LPT) displacement fields on the mesh.
+
+    Returns (psi1, psi2): lists of three real fields each (psi2 is
+    None for order=1).  Spectral throughout — six c2r per order-2
+    off-diagonal/diagonal Hessian component plus one r2c for the 2LPT
+    source, all through the sharded ``dist_rfftn`` drivers.
+    """
+    if order not in (1, 2):
+        raise ValueError("order must be 1 (ZA) or 2 (2LPT)")
+    kv, inv = _k_inv_k2(pm)
+    psi1 = [pm.c2r(1j * kv[i] * inv * delta_k) for i in range(3)]
+    if order == 1:
+        return psi1, None
+    # phi_{,ij}(k) = k_i k_j / k^2 delta_k; S2 = sum_{i<j} (d_ii d_jj - d_ij^2)
+    diag = [pm.c2r(kv[i] * kv[i] * inv * delta_k) for i in range(3)]
+    src = (diag[0] * diag[1] + diag[0] * diag[2] + diag[1] * diag[2])
+    for i, j in ((0, 1), (0, 2), (1, 2)):
+        od = pm.c2r(kv[i] * kv[j] * inv * delta_k)
+        src = src - od * od
+    src_k = pm.r2c(src)
+    psi2 = [pm.c2r(1j * kv[i] * inv * src_k) for i in range(3)]
+    return psi1, psi2
+
+
+def lpt_init(pm, delta_k, a=0.1, order=2):
+    """Particle (positions, momenta) at scale factor ``a`` from linear
+    modes, one particle per mesh cell (box units).
+
+    The lattice is ``generate_uniform_particle_grid(shift=0)`` whose
+    x-fastest raster order matches ``field.reshape(-1)``, so the
+    displacement at each particle is a reshape of the displacement
+    field — exact and trivially differentiable.
+    """
+    psi1, psi2 = lpt_displacements(pm, delta_k, order=order)
+    cdt = jnp.dtype(pm.compute_dtype)
+    q = pm.generate_uniform_particle_grid(shift=0.0, dtype=cdt)
+    d1 = jnp.stack([p.reshape(-1).astype(cdt) for p in psi1], axis=-1)
+    a = jnp.asarray(a, cdt)
+    pos = q + a * d1
+    mom = a ** 1.5 * d1
+    if psi2 is not None:
+        d2 = jnp.stack([p.reshape(-1).astype(cdt) for p in psi2], axis=-1)
+        pos = pos + (-3.0 / 7.0) * a ** 2 * d2
+        mom = mom + a ** 1.5 * (-6.0 / 7.0) * a * d2
+    return pos, mom

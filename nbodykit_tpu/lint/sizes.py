@@ -84,6 +84,22 @@ OWNERSHIP_TAILS = frozenset({
     'rfftn_single_lowmem', 'irfftn_single_lowmem',
     'fftn_c2c_single_lowmem'})
 
+#: reverse-mode transform tails.  ``jax.grad(f)(x)`` (and the
+#: ``value_and_grad`` / ``vjp`` / ``jacrev`` / ``jacfwd`` spellings)
+#: runs f's forward AND holds f's intermediates live as residuals for
+#: the backward pass — so a grad call site prices the wrapped
+#: function's internal peak ONCE MORE on top of the forward run
+#: (reverse mode doubles live mesh buffers; the same honesty
+#: ``pmesh.memory_plan(workload='forward')`` applies).  Without this
+#: the report silently under-prices every gradient pipeline.
+GRAD_TAILS = frozenset({'grad', 'value_and_grad', 'vjp', 'jacrev',
+                        'jacfwd'})
+
+#: the one grad-family spelling that runs the forward AT the transform
+#: call itself (``y, pullback = jax.vjp(f, x)``); the rest are lazy
+#: wrappers priced where the wrapped function is invoked
+_GRAD_EAGER_TAILS = frozenset({'vjp'})
+
 #: internal symbolic peaks of producers we cannot (or choose not to)
 #: resolve — the documented buffer contracts (dfft.py docstrings)
 _PRODUCER_INTERNAL = {
@@ -195,6 +211,18 @@ def _mesh_shape_like(ctx, expr, mesh_names):
             if _MESH_TOKEN_RE.match(sub.attr):
                 return True
     return False
+
+
+def _grad_wrapped_expr(ctx, expr):
+    """The function expression wrapped by a grad-family transform
+    somewhere inside ``expr`` (``jax.grad(f)``,
+    ``jit(value_and_grad(f))``, ...), or None."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            q = ctx.call_name(sub) or ''
+            if _tail(q) in GRAD_TAILS and sub.args:
+                return sub.args[0]
+    return None
 
 
 _OWN = '<own>'      # taint label: derived from a full-mesh producer
@@ -472,6 +500,51 @@ class _FuncMem(object):
                 out.append((call, tgt, mesh_args))
         return out
 
+    # -- reverse-mode call sites -------------------------------------------
+
+    def _grad_callee(self, call):
+        """The function reverse-mode-transformed at this call site, or
+        None.  Recognized spellings: immediately-invoked
+        ``grad(f)(x)`` / ``jit(value_and_grad(f))(x)``, the direct
+        ``vjp(f, x)`` form, and ``g(x)`` where ``g = grad(f)`` (or a
+        jit-wrapped grad) was assigned anywhere in the module."""
+        ctx = self.ctx
+        expr = None
+        func = call.func
+        if isinstance(func, ast.Call):
+            expr = _grad_wrapped_expr(ctx, func)
+        if expr is None:
+            q = ctx.call_name(call) or ''
+            if _tail(q) in _GRAD_EAGER_TAILS and call.args:
+                expr = call.args[0]
+        if expr is None and isinstance(func, ast.Name):
+            expr = self.analysis.grad_names(ctx).get(func.id)
+        if expr is None:
+            return None
+        return self._resolve_func_expr(expr)
+
+    def _resolve_func_expr(self, expr):
+        """A function expression -> its def/lambda node (for
+        ``summary_of``), through one layer of jit-family wrapping."""
+        if isinstance(expr, _FUNC_NODES):
+            return expr
+        project = getattr(self.ctx, 'project', None)
+        if project is None:
+            return None
+        if isinstance(expr, ast.Call):
+            unwrapped = project._unwrap(self.ctx, expr)
+            if unwrapped is None:
+                return None
+            expr = unwrapped[0]
+            if isinstance(expr, _FUNC_NODES):
+                return expr
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            tref = project._resolve(self.ctx, expr, expr,
+                                    frozenset(), False)[0]
+            if tref is not None:
+                return tref.node
+        return None
+
     # -- the symbolic peak -------------------------------------------------
 
     def peak_units(self):
@@ -526,6 +599,20 @@ class _FuncMem(object):
             if internal:
                 extras[line] += max(
                     0.0, internal - (1.0 if result_mesh else 0.0))
+            # reverse mode: the transformed function's forward runs
+            # inside the grad call (it is NOT a resolved plain callee
+            # unless the resolver saw through the wrapper), and its
+            # intermediates stay live as residuals for the backward
+            # pass — price the wrapped peak once more on top
+            gnode = self._grad_callee(call)
+            if gnode is not None:
+                gpeak = self.analysis.summary_of(gnode).peak
+                if gpeak:
+                    resolved = tgt.ref.node \
+                        if tgt is not None and tgt.ref is not None \
+                        else None
+                    extras[line] += gpeak if resolved is gnode \
+                        else 2.0 * gpeak
         lines = set(extras)
         for birth, death in self.intervals.values():
             lines.add(birth)
@@ -559,6 +646,7 @@ class _Analysis(object):
         self.project = project
         self.summaries = {}
         self._func_mem = {}
+        self._grad_name_cache = {}
         for _ in range(6):
             changed = False
             for ctx, fn in project.functions():
@@ -576,6 +664,26 @@ class _Analysis(object):
     def summary_of(self, fn):
         return self.summaries.get(
             id(fn), MemSummary('no', frozenset(), 0.0))
+
+    def grad_names(self, ctx):
+        """{name: wrapped function expr} for module-wide assignments
+        of grad-family transforms (``vg = jax.jit(
+        jax.value_and_grad(loss))`` and kin)."""
+        cache = self._grad_name_cache.get(id(ctx))
+        if cache is None:
+            cache = {}
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                wrapped = _grad_wrapped_expr(ctx, node.value)
+                if wrapped is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        cache[t.id] = wrapped
+            self._grad_name_cache[id(ctx)] = cache
+        return cache
 
     def func_mem(self, fn):
         return self._func_mem.get(id(fn))

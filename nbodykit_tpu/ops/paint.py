@@ -23,7 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .window import window_weights, window_support
+from .window import window_weights, window_weights_grad, window_support
 # '.trace.' metrics below are bumped once per COMPILATION of the
 # enclosing program (these kernels run inside jit/shard_map), not per
 # execution — they document which kernel got traced at what size, not
@@ -40,16 +40,27 @@ install_compile_telemetry()
 ZCHUNK_BYTES = 1 << 28
 
 
-def _axis_terms(pos_ax, resampler, period):
+def _axis_terms(pos_ax, resampler, period, grad=False):
     """Per-axis neighbor indices (wrapped mod period) and weights,
-    shapes (n, s)."""
-    idx, w = window_weights(pos_ax, resampler)
+    shapes (n, s).  ``grad=True`` returns the derivative weights
+    dW/dx (cell units) instead — the per-axis factor of the analytic
+    paint/readout adjoint (forward/adjoint.py)."""
+    if grad:
+        idx, w = window_weights_grad(pos_ax, resampler)
+    else:
+        idx, w = window_weights(pos_ax, resampler)
     return jnp.mod(idx, period), w
 
 
-def _offset_terms(pos, mass, resampler, period, origin, n0l):
+def _offset_terms(pos, mass, resampler, period, origin, n0l,
+                  grad_axis=None):
     """Yield (flat_rows_valid, lin_index, weight) triples — one per
-    static window offset (i, j, k) in s^3 — all 1-D over particles."""
+    static window offset (i, j, k) in s^3 — all 1-D over particles.
+
+    ``grad_axis`` (0/1/2) swaps that axis's window factor for its
+    derivative dW/dx, so the weighted gather computes
+    d(interpolation)/d(pos[grad_axis]) in cell units — the readout
+    side of the paint position-adjoint."""
     s = window_support(resampler)
     N1, N2 = period[1], period[2]
     # trace-time overflow guard: lin below peaks at n0l*N1*N2 - 1 and
@@ -60,9 +71,12 @@ def _offset_terms(pos, mass, resampler, period, origin, n0l):
             'local block (%d, %d, %d) overflows int32 flat indexing; '
             'shard the mesh over more devices or reduce nmesh'
             % (n0l, N1, N2))
-    i0, w0 = _axis_terms(pos[:, 0], resampler, period[0])
-    i1, w1 = _axis_terms(pos[:, 1], resampler, period[1])
-    i2, w2 = _axis_terms(pos[:, 2], resampler, period[2])
+    i0, w0 = _axis_terms(pos[:, 0], resampler, period[0],
+                         grad=grad_axis == 0)
+    i1, w1 = _axis_terms(pos[:, 1], resampler, period[1],
+                         grad=grad_axis == 1)
+    i2, w2 = _axis_terms(pos[:, 2], resampler, period[2],
+                         grad=grad_axis == 2)
     # local row index relative to block origin
     for a in range(s):
         row = jnp.mod(i0[:, a] - origin, period[0])
@@ -144,10 +158,13 @@ def paint_local(pos, mass, shape, resampler='cic', period=None, origin=0,
 
 
 def readout_local(block, pos, resampler='cic', period=None, origin=0,
-                  chunk=None):
+                  chunk=None, grad_axis=None):
     """Interpolate a local mesh block at particle positions (gather).
 
     Parameters mirror :func:`paint_local`; out-of-block rows contribute 0.
+    ``grad_axis`` (0/1/2) computes d(interpolation)/d(pos[grad_axis])
+    in cell units instead — the position cotangent of the paint
+    adjoint (forward/adjoint.py): d/dx of sum_c block[c] W_c(x).
 
     Returns
     -------
@@ -166,7 +183,7 @@ def readout_local(block, pos, resampler='cic', period=None, origin=0,
     def body(pos_c):
         vals = jnp.zeros(pos_c.shape[0], dtype=block.dtype)
         for lin, w in _offset_terms(pos_c, None, resampler, period,
-                                    origin, n0l):
+                                    origin, n0l, grad_axis=grad_axis):
             vals = vals + flat[lin] * w.astype(block.dtype)
         return vals
 

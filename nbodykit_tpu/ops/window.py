@@ -53,6 +53,44 @@ def bspline(d, s):
                      jnp.maximum(2.0 - d, 0.0) ** 3 / 6.0)
 
 
+def bspline_deriv(d, s):
+    """dW/dd of :func:`bspline` at |distance| ``d`` (cell units).
+
+    Piecewise form of the B-spline derivative, matching the a.e.
+    derivative jax autodiff produces for :func:`bspline` — the
+    analytic paint/readout adjoints (forward/adjoint.py) must agree
+    with native reverse mode wherever both are defined.  At the
+    (measure-zero) kinks the subgradient choice follows the jnp
+    primitives above (``where``/``maximum``)."""
+    if s == 1:
+        return jnp.zeros_like(d)
+    if s == 2:
+        return jnp.where(d < 1.0, -jnp.ones_like(d), 0.0)
+    if s == 3:
+        return jnp.where(d <= 0.5, -2.0 * d,
+                         -jnp.maximum(1.5 - d, 0.0))
+    return jnp.where(d <= 1.0, (-12.0 * d + 9.0 * d * d) / 6.0,
+                     -0.5 * jnp.maximum(2.0 - d, 0.0) ** 2)
+
+
+def window_weights_grad(x, resampler):
+    """Per-axis neighbor indices and dW/dx weights (cell units) for
+    particles at cell coordinate ``x`` — the derivative companion of
+    :func:`window_weights`, consumed by the gradient readout
+    (ops/paint.py ``grad_axis``) that backs the analytic paint
+    adjoint.
+
+    Returns (idx, dw) with dw = W'(|x - idx|) * sign(x - idx); the
+    per-axis dw sum to 0 along the last axis (the windows sum to 1
+    for every x)."""
+    s = window_support(resampler)
+    base = window_base(x, resampler)
+    offs = jnp.arange(s, dtype=jnp.int32)
+    idx = base[..., None] + offs
+    delta = x[..., None] - idx.astype(x.dtype)
+    return idx, bspline_deriv(jnp.abs(delta), s) * jnp.sign(delta)
+
+
 def window_weights(x, resampler):
     """Per-axis neighbor indices and weights for particles at cell
     coordinate ``x`` (float, cell units).

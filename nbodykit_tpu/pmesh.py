@@ -621,7 +621,7 @@ class ParticleMesh(object):
         return block, dropped, capacity
 
     def readout(self, real, pos, resampler=None, capacity=None,
-                return_dropped=False):
+                return_dropped=False, grad_axis=None):
         """Interpolate a real field at particle positions (inverse of
         paint; reference: pmesh Field.readout, used by FFTRecon at
         algorithms/fftrecon.py:217-268).
@@ -629,23 +629,28 @@ class ParticleMesh(object):
         ``capacity``/``return_dropped`` follow the same overflow
         contract as :meth:`paint`; eager calls emit a ``readout`` span
         under diagnostics (same sync semantics as :meth:`paint`).
+
+        ``grad_axis`` (0/1/2) reads the window-DERIVATIVE
+        interpolation d(readout)/d(pos[grad_axis]) instead, in CELL
+        units (multiply by Nmesh/BoxSize for box units) — the position
+        cotangent of the paint adjoint (docs/FORWARD.md).
         """
         if current_tracer() is None or not trace_state_clean():
             return self._readout_impl(real, pos, resampler, capacity,
-                                      return_dropped)
+                                      return_dropped, grad_axis)
         npart = int(pos.shape[0])
         t0 = time.perf_counter()
         with span('readout', npart=npart, nproc=self.nproc,
                   nmesh=int(self.Nmesh[0])):
             res = self._readout_impl(real, pos, resampler, capacity,
-                                     return_dropped)
+                                     return_dropped, grad_axis)
             jax.block_until_ready(res)
         dt = max(time.perf_counter() - t0, 1e-9)
         histogram('readout.mpart_per_s').observe(npart / dt / 1e6)
         return res
 
     def _readout_impl(self, real, pos, resampler, capacity,
-                      return_dropped):
+                      return_dropped, grad_axis=None):
         from .utils import is_narrow_float
         real = jnp.asarray(real)
         if is_narrow_float(real.dtype):
@@ -661,7 +666,8 @@ class ParticleMesh(object):
 
         if self.nproc == 1:
             out = readout_local(real, cpos, resampler=resampler,
-                                period=self.shape_real, origin=0)
+                                period=self.shape_real, origin=0,
+                                grad_axis=grad_axis)
             if return_dropped:
                 return out, jnp.zeros((), jnp.int32)
             return out
@@ -679,7 +685,8 @@ class ParticleMesh(object):
             origin = d * n0 - h
             ext = halo_fill(real_l, h, nproc)
             return readout_local(ext, cpos_l, resampler=resampler,
-                                 period=(N0, N1, N2), origin=origin)
+                                 period=(N0, N1, N2), origin=origin,
+                                 grad_axis=grad_axis)
 
         def attempt(cap):
             recv, valid, dropped = exchange_by_dest(
@@ -760,7 +767,8 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
                 paint_streams=None, hbm_bytes=16e9, exchange='counted',
                 exchange_imbalance=1.5, fft_decomp='slab',
                 fft_pencil=None, ingest_chunk_rows=None,
-                catalog_bytes=None):
+                catalog_bytes=None, workload='fftpower',
+                pm_steps=None):
     """Estimated peak per-device HBM for the FFTPower pipeline
     (paint -> rFFT -> |delta_k|^2 -> chunked binning) — the arithmetic
     behind chunk-size choices and the BASELINE.md scale claims
@@ -798,6 +806,22 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     "Halving the bytes").  The report's ``mesh_dtype`` /
     ``mesh_itemsize`` keys record what was priced so admission
     rejections can quote it.
+
+    ``workload='forward'`` prices the differentiable LPT/PM pipeline
+    (nbodykit_tpu.forward, docs/FORWARD.md) instead of the FFTPower
+    one: ``pm_steps`` kick-drift-kick steps, each a paint -> Poisson
+    solve -> 3-component force readout, differentiated end to end
+    with ``jax.grad``.  The forward pass adds the particle *state*
+    (positions + momenta, 6 compute words per particle) and the three
+    per-axis force meshes to the usual mesh pipeline; the REVERSE
+    pass is the honest part — ``jax.grad`` holds each step's saved
+    primals (the particle state plus two live mesh buffers: painted
+    density and potential) across the whole backward sweep, so the
+    residual term scales LINEARLY with ``pm_steps`` and the backward
+    peak roughly doubles the per-step live mesh working set.  The
+    report carries ``forward_state_bytes`` / ``grad_residual_bytes``
+    / ``workload`` / ``pm_steps`` so an admission rejection can quote
+    exactly which term broke the budget.
 
     ``ingest_chunk_rows`` prices the streaming-ingestion pipeline of a
     ``data_ref`` request (nbodykit_tpu.ingest): the resident sharded
@@ -949,6 +973,26 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     peak = max(real + pos_b + paint_tmp + exch + ingest_buf,
                real + cplx + fft_ws + pos_b,
                cplx + p3 + pos_b)
+    if workload == 'forward':
+        steps = max(int(pm_steps or 1), 1)
+        # KDK particle state: positions + momenta, always live
+        part_state = 6 * citem * npart / ndev
+        # per-axis force meshes read out at the particle positions
+        force_fields = 3 * real
+        fwd_peak = max(real + part_state + paint_tmp + exch,
+                       real + cplx + fft_ws + part_state,
+                       real + cplx + force_fields + part_state)
+        # reverse-mode residuals: jax.grad keeps each step's saved
+        # primals (particle state + painted density + potential mesh)
+        # alive across the whole backward sweep — linear in pm_steps —
+        # and the backward step re-runs a paint/readout pair, doubling
+        # that step's live mesh working set on top of the pile
+        residual = steps * (part_state + 2 * real)
+        peak = fwd_peak + residual + real + cplx
+        phases['workload'] = 'forward'
+        phases['pm_steps'] = steps
+        phases['forward_state_bytes'] = part_state + force_fields
+        phases['grad_residual_bytes'] = residual
     phases['peak_bytes'] = peak
     # the budget the admission controller (nbodykit_tpu.serve) prices
     # against: the raw HBM less the 15% allocator margin.  Exposed so

@@ -77,8 +77,11 @@ def _plan(request, ndevices, hbm_bytes, paint_chunk=None,
         from ..tune.resolve import resolve_paint
         method = resolve_paint(
             nmesh=request.nmesh, npart=request.npart,
-            dtype=request.dtype, nproc=ndevices).get('paint_method',
-                                                     'scatter')
+            dtype=request.dtype, nproc=ndevices,
+            # Forward runs the grad-safe resolution (a cached winner
+            # with no adjoint story demotes) — price what executes
+            differentiable=request.algorithm == 'Forward',
+        ).get('paint_method', 'scatter')
         if method == 'auto':
             method = 'scatter'
     chunk_rows = None
@@ -89,13 +92,20 @@ def _plan(request, ndevices, hbm_bytes, paint_chunk=None,
         from ..ingest.stream import resolve_chunk_rows
         chunk_rows = resolve_chunk_rows(npart=request.npart,
                                         nproc=ndevices)
+    # a Forward request is a forward+BACKWARD pipeline: price it with
+    # the reverse-mode branch (per-step residuals held live) instead
+    # of the one-shot fftpower peak
+    workload = 'forward' if request.algorithm == 'Forward' \
+        else 'fftpower'
     return memory_plan(request.nmesh, request.npart,
                        ndevices=ndevices, dtype=request.dtype,
                        resampler=request.resampler,
                        paint_method=method, paint_chunk=paint_chunk,
                        hbm_bytes=hbm_bytes,
                        ingest_chunk_rows=chunk_rows,
-                       catalog_bytes=catalog_bytes)
+                       catalog_bytes=catalog_bytes,
+                       workload=workload,
+                       pm_steps=getattr(request, 'pm_steps', None))
 
 
 def catalog_fits_fn(request, ndevices=1, hbm_bytes=16e9):
@@ -150,6 +160,17 @@ def admit(request, ndevices=1, hbm_bytes=16e9):
             'code': 'indivisible', 'nmesh': request.nmesh,
             'ndevices': ndevices, 'resampler': request.resampler,
             'detail': 'resampler support exceeds the per-device slab'})
+    if request.algorithm == 'Forward':
+        # the particle lattice is a second mesh (ng^3 = npart) and
+        # must shard over the same sub-mesh
+        ng = int(round(float(request.npart) ** (1.0 / 3.0)))
+        if ng % ndevices:
+            return AdmissionDecision(REJECT, request.request_id,
+                                     reason={
+                'code': 'indivisible', 'npart': request.npart,
+                'ndevices': ndevices,
+                'detail': 'Forward particle lattice ng=%d must be '
+                          'divisible by the sub-mesh size' % ng})
 
     plan = _plan(request, ndevices, hbm_bytes)
     if plan['fits']:

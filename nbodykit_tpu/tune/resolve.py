@@ -23,6 +23,20 @@ which of a run's choices were measured and which were defaults.
 
 from .cache import TuneCache, device_signature, shape_class
 
+# paint kernels jax reverse mode differentiates natively: the scatter
+# chain is pure .at[].add / gather jnp ops whose VJP is the existing
+# readout.  'sort' (while_loop), 'segsum'/'streams' (argsort buckets,
+# replica-mesh fori loops) and 'mxu' (slack-sized buckets with the
+# traced return_dropped overflow contract) are NOT — they either
+# refuse reverse mode outright or impose contracts a silent custom_vjp
+# forward cannot honor.  forward/adjoint.py wraps the GRAD_WRAPPED set
+# in explicit custom_vjp pairs (winner kernel forward, readout-based
+# analytic backward); anything else demotes via
+# resolve_paint(differentiable=True) — the grad-mode fallback the
+# resolver knows about (docs/FORWARD.md).
+DIFFERENTIABLE_PAINT = frozenset({'scatter'})
+GRAD_WRAPPED_PAINT = frozenset({'sort', 'segsum', 'streams'})
+
 # the pre-tuner defaults, used verbatim on a cold cache
 FALLBACKS = {
     'paint_method': 'scatter',
@@ -65,12 +79,25 @@ def _consult(op, sclass, dtype, nproc):
     return dict(entry['winner']), 'cache-nearest'
 
 
-def resolve_paint(nmesh, npart, dtype='f4', nproc=1):
+def resolve_paint(nmesh, npart, dtype='f4', nproc=1,
+                  differentiable=False):
     """The effective paint configuration for one call: current options
     with every ``'auto'`` replaced by the cache winner (or the
     fallback).  Returns the four paint options plus ``source``
     (``'explicit'`` when nothing was ``'auto'``) and, when the cache
-    answered, ``winner_name``."""
+    answered, ``winner_name``.
+
+    ``differentiable=True`` is the grad-mode resolution
+    (docs/FORWARD.md): a winner whose kernel jax cannot reverse-
+    differentiate natively (:data:`DIFFERENTIABLE_PAINT`) is DEMOTED
+    to the nearest differentiable candidate ('scatter' — same
+    one-chain deposit, natively adjoint via readout) instead of
+    tracing into a ``jax.grad`` error deep inside the pipeline.  The
+    demotion is never silent: ``source`` becomes ``'grad-fallback'``,
+    the original winner stays in ``winner_name``, the
+    ``tune.grad_fallback`` counter bumps and a one-line WARN is
+    logged.  Explicit (non-'auto') methods demote the same way —
+    grad mode is a hard correctness constraint, not a preference."""
     opts = {k: _current(k) for k in
             ('paint_method', 'paint_order', 'paint_deposit',
              'paint_chunk_size', 'paint_streams')}
@@ -108,6 +135,19 @@ def resolve_paint(nmesh, npart, dtype='f4', nproc=1):
             not isinstance(cfg['paint_streams'], (int, float)):
         cfg['paint_streams'] = FALLBACKS['paint_streams']
     cfg['paint_streams'] = int(cfg['paint_streams'])
+    if differentiable and cfg['paint_method'] not in \
+            DIFFERENTIABLE_PAINT:
+        from ..diagnostics import counter
+        import logging
+        demoted = cfg['paint_method']
+        cfg.setdefault('winner_name', demoted)
+        cfg['paint_method'] = 'scatter'
+        cfg['source'] = 'grad-fallback'
+        counter('tune.grad_fallback').add(1)
+        logging.getLogger('nbodykit_tpu.tune').warning(
+            "grad-mode paint resolution: demoting %r (not natively "
+            "differentiable) to 'scatter' for this call "
+            "(tune.grad_fallback)", demoted)
     return cfg
 
 

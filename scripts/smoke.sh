@@ -350,6 +350,58 @@ print('shadow gate OK: 1 request shadow-verified bit-identical '
       'across sub-meshes, 0 mismatches')
 EOF
 
+# forward-model gate (docs/FORWARD.md): the differentiable pipeline
+# must stay differentiable on every smoke run — a bounded 64^3 mesh /
+# 1e4-particle KDK step is checked against a central finite difference
+# (eps below the CIC kink noise at f8), then one Forward request rides
+# the serve plane end to end: admitted with the reverse-pass memory
+# branch, completed, nothing lost
+echo "== forward gate (64^3/1e4 grad check + 1-request serve) =="
+python - <<'EOF'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', True)
+import jax.numpy as jnp
+from nbodykit_tpu.forward import ForwardModel, make_loss
+model = ForwardModel(64, 22 ** 3, BoxSize=1000.0, pm_steps=1,
+                     dtype='f8')
+truth = model.linear_modes(0)
+obs = jax.jit(model.density)(truth)
+loss = make_loss(model, obs, noise_std=0.1)
+w0 = model.lattice.c2r(model.lattice.generate_whitenoise(1)) * 0.05
+g = jax.jit(jax.grad(loss))(w0)
+d = model.lattice.c2r(model.lattice.generate_whitenoise(2))
+d = d / jnp.sqrt(jnp.sum(d * d))
+eps = 1e-6
+lj = jax.jit(loss)
+fd = (float(lj(w0 + eps * d)) - float(lj(w0 - eps * d))) / (2 * eps)
+dot = float(jnp.sum(g * d))
+rel = abs(fd - dot) / max(abs(fd), 1e-300)
+assert rel < 1e-4, "grad check VIOLATED: fd=%r grad=%r rel=%.3e" % (
+    fd, dot, rel)
+print('forward grad OK: mesh64/n%d kdk, |fd-grad|/|fd| = %.3e'
+      % (model.npart, rel))
+EOF
+python - <<'EOF'
+from nbodykit_tpu._jax_compat import set_cpu_devices
+set_cpu_devices(8)
+import jax
+jax.config.update('jax_enable_x64', True)
+import numpy as np
+from nbodykit_tpu.serve import COMPLETED, AnalysisRequest, AnalysisServer
+with AnalysisServer(per_task=4) as srv:
+    r = srv.wait(srv.submit(AnalysisRequest(
+        algorithm='Forward', nmesh=16, npart=8 ** 3, pm_steps=1,
+        seed=5, deadline_s=600.0)))
+    summary = srv.summary()
+assert r.status == COMPLETED, r
+y = np.asarray(r.y)
+assert np.isfinite(y).all() and np.abs(y).sum() > 0, y
+assert summary['lost'] == 0, summary
+print('forward serve OK: 1 Forward request completed '
+      '(mesh16/n512 x1 step), lost=0')
+EOF
+
 # region gate (docs/SERVING.md "Region"): a two-fleet router trace
 # with a third fleet joining mid-trace — the bench asserts the whole
 # region posture in one shot: >=1 content-addressed result-cache hit
@@ -567,6 +619,7 @@ python -m pytest \
     tests/test_pencil_fft.py \
     tests/test_paint_kernels.py \
     tests/test_fftpower.py \
+    tests/test_forward.py \
     tests/test_counted_exchange.py \
     tests/test_radix.py \
     tests/test_ingest.py \

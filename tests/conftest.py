@@ -70,6 +70,10 @@ _SLOW = {
     "test_fftpower.py::test_fftpower_shotnoise_flat[single]",
     "test_fftpower.py::test_linear_mesh_recovers_power[multi]",
     "test_fof.py::test_fof_com_periodic",
+    "test_forward.py::test_forward_served_end_to_end_with_shadow_verify",
+    "test_forward.py::test_kdk_gradient_matches_fd_multi",
+    "test_forward.py::test_recovery_beats_fftrecon_128",
+    "test_forward.py::test_recovery_beats_fftrecon_small",
     "test_fof.py::test_fof_features_and_com",
     "test_fof.py::test_fof_matches_brute_force",
     "test_fof.py::test_fof_mean_separation_units",

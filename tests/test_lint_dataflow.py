@@ -320,6 +320,62 @@ def test_nbk503_silent_without_config_and_under_budget():
     assert lint_str(src, select=['NBK503'], memory_config=small) == []
 
 
+def test_nbk503_grad_call_site_prices_the_backward_pass():
+    """ISSUE 19 satellite: ``jax.grad(f)`` holds f's intermediates as
+    residuals for the backward pass, so a grad call site must add f's
+    internal peak once more.  The fixture pair: the forward-only
+    pipeline FITS the declared budget; the identical pipeline under
+    ``jax.grad`` EXCEEDS it — if the grad accounting regresses to
+    zero, the second assertion catches the silent under-report."""
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def loss(pm, x):
+        a = pm.paint(x)
+        b = pm.r2c(a)
+        return jnp.abs(b).sum()
+
+    def forward_fits(pm):
+        w = pm.generate_whitenoise(0)
+        return loss(pm, w)
+
+    def grad_exceeds(pm):
+        w = pm.generate_whitenoise(0)
+        g = jax.grad(loss, argnums=1)(pm, w)
+        return g.sum()
+    """
+    # 1 unit = 4.29 GB; budget 0.85*28 GB = 23.8 GB: the forward
+    # pipeline (5 units = 21.5 GB) fits, the grad pipeline (forward
+    # + residuals + live leaves = 10 units = 42.9 GB) does not
+    config = lint.make_config(1024, dtype_bytes=4, hbm_bytes=28e9)
+    fs = lint_str(src, select=['NBK503'], memory_config=config)
+    assert codes(fs) == ['NBK503']
+    assert 'grad_exceeds' in fs[0].message
+    # the named-wrapper spelling (vg = jit(value_and_grad(f)); vg(x))
+    # prices the same residuals — not only the immediate form
+    named = """
+    import jax
+    import jax.numpy as jnp
+
+    def loss(pm, x):
+        a = pm.paint(x)
+        b = pm.r2c(a)
+        return jnp.abs(b).sum()
+
+    def grad_named(pm):
+        w = pm.generate_whitenoise(0)
+        vg = jax.jit(jax.value_and_grad(loss, argnums=1))
+        val, g = vg(pm, w)
+        return g.sum()
+    """
+    fs2 = lint_str(named, select=['NBK503'], memory_config=config)
+    assert codes(fs2) == ['NBK503']
+    assert 'grad_named' in fs2[0].message
+    # (11 units for the named form: the value_and_grad closure object
+    # is a live leaf alongside the residuals)
+
+
 # ---------------------------------------------------------------------------
 # the symbolic peak model against the documented dfft buffer contracts
 
