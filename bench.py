@@ -256,7 +256,8 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
     import jax
     import jax.numpy as jnp
     from nbodykit_tpu.ops.window import compensation_transfer
-    from nbodykit_tpu.ops.histogram import hist2d_weighted
+    from nbodykit_tpu.ops.histogram import (hist2d_weighted,
+                                            lattice_shell_index)
 
     Nmesh = int(pm.Nmesh[0])
     L = float(pm.BoxSize[0])
@@ -291,15 +292,9 @@ def _bench_fftpower_fn(pm, resampler='cic', slab_chunks=16):
                                        (rows,)).reshape(rows, 1, 1)
             isq = (ix_full * ix_full + iy * iy + iz_full * iz_full)
             wgt = jnp.broadcast_to(herm_z, sl.shape).reshape(-1)
-            # k-bin = floor(sqrt(isq)) + 1 with exact integer
-            # correction of the f32 sqrt rounding (replaces a
-            # searchsorted binary search: one rsqrt + two integer
-            # compares per element instead of ~10 gather rounds)
-            r = jnp.sqrt(isq.astype(jnp.float32)).astype(jnp.int32)
-            # (r+1)^2 <= 3*(Nmesh/2+1)^2 ~ 1.3e7 at Nmesh=4096 —
-            # far inside int32  # nbkl: disable=NBK704
-            r = r - (r * r > isq) + ((r + 1) * (r + 1) <= isq)
-            dig_k = jnp.minimum(r + 1, Nx + 1)
+            # k-bin = floor(sqrt(isq)) + 1 (shell Nx is the overflow
+            # bin): exact shell assignment via the shared helper
+            dig_k = lattice_shell_index(isq, Nx + 1) + 1
             dig_k = jnp.broadcast_to(dig_k, sl.shape).reshape(-1)
             # exact integer mu binning (edges m/5, m=-5..5; mu >= 0 on
             # the half-spectrum): mu >= m/5  <=>  25*iz^2 >= m^2*isq.
@@ -1707,6 +1702,145 @@ def run_forward(nmesh=32, npart=None, steps=2, seed=0):
     return _stamp(rec)
 
 
+def run_bispectrum(nmesh=32, npart=20000, nbins=3, seed=0):
+    """The higher-order-statistics round (docs/BISPECTRUM.md): the
+    Scoccimarro FFT estimator raced against the blocked direct
+    pairwise-summation path on the SAME deterministic catalog — the
+    first FLOPs-bound workload in the suite.
+
+    The record stamps the per-shape crossover evidence the ``bspec``
+    tune space turns into cached winners:
+
+    - *fft_s* / *direct_s*: full-estimator wall seconds (paint + r2c +
+      triangle stream vs pairblock mode sums + host combination), min
+      of BENCH_REPS;
+    - *crossover*: the speedup ratio and which path won AT THIS SHAPE
+      (the direct path's O(Npart x Nk) dense matmuls beat the FFT's
+      mesh pipeline only where the MXU can stream them — per-platform,
+      never guessed);
+    - *agreement*: with ``2 (nbins+1) <= nmesh/2`` no aliased triangle
+      exists, the mod-N and true closures coincide, and the two paths
+      measure the SAME statistic: ``ntri`` must match bit for bit and
+      B to window/resolution tolerance.  ``agree_ok`` False is the
+      doctor's FAIL — two estimators of one statistic disagreeing
+      means one of them is wrong.
+
+    The catalog carries an imprinted non-Gaussian weight field (a
+    squared cosine sum) so the bispectrum signal dominates shot noise;
+    ``value`` is the winning path's wall seconds."""
+    jax = _setup_jax()
+    import contextlib
+    import numpy as np
+
+    from nbodykit_tpu.algorithms.bispectrum import (direct_bispectrum,
+                                                    fft_bispectrum)
+    from nbodykit_tpu.parallel.runtime import (cpu_mesh, mesh_size,
+                                               tpu_mesh, use_mesh)
+    from nbodykit_tpu.pmesh import ParticleMesh, memory_plan
+    from nbodykit_tpu.tune.resolve import (resolve_bispectrum,
+                                           tuned_snapshot)
+    from nbodykit_tpu.utils import is_mxu_backend
+
+    mesh = tpu_mesh() if is_mxu_backend() else cpu_mesh()
+    nproc = mesh_size(mesh)
+    L = 1000.0
+    rec = {"metric": "bispectrum_mesh%d_n%d_b%d"
+                     % (nmesh, npart, nbins),
+           "unit": "s", "platform": jax.devices()[0].platform,
+           "nproc": nproc, "nmesh": nmesh, "npart": npart,
+           "nbins": nbins, "seed": seed}
+    rng = np.random.RandomState(seed + 11)
+    pos = rng.uniform(0.0, L, size=(npart, 3))
+    # imprinted non-Gaussian weights: squared sum of low-|q| cosines
+    g = np.zeros(npart)
+    for m in [(1, 0, 0), (0, 1, 0), (0, 0, 1), (1, 1, 0), (0, 1, 1),
+              (1, 0, 1), (2, 0, 0), (1, 1, 1)]:
+        ph = rng.uniform(0, 2 * np.pi)
+        g += 0.4 * np.cos(2 * np.pi * (pos @ np.array(m)) / L + ph)
+    w = (1.0 + 0.5 * g) ** 2
+
+    ctx = use_mesh(mesh) if nproc >= 2 else contextlib.nullcontext()
+    with ctx:
+        import jax.numpy as jnp
+        comm = mesh if nproc >= 2 else None
+        cfg = resolve_bispectrum(nmesh=nmesh, npart=npart,
+                                 nproc=nproc)
+        tile = int(cfg['pairblock_tile'])
+        rec['pairblock_tile'] = tile
+        rec['resolved_method'] = cfg['bspec_method']
+        pm = ParticleMesh(Nmesh=nmesh, BoxSize=L, dtype='f4',
+                          comm=comm)
+        posj = jnp.asarray(pos, pm.dtype)
+        wj = jnp.asarray(w, pm.dtype)
+        # match the direct path's (1/W) sum_j w_j e^{-ikx} convention
+        scale = float(pm.Ntot) / float(w.sum())
+
+        def fft_once():
+            delta = pm.paint(posj, wj) * scale
+            return fft_bispectrum(pm, pm.r2c(delta), nbins)
+
+        def direct_once():
+            return direct_bispectrum(posj, wj, L, nbins, tile=tile,
+                                     comm=comm)
+
+        reps = int(os.environ.get('BENCH_REPS', '3') or 3)
+        rec['reps'] = reps
+        t0 = time.time()
+        Bf, ntri_f = fft_once()                   # warm/compile rep
+        rec['compile_fft_s'] = round(time.time() - t0, 4)
+        t0 = time.time()
+        Bd, ntri_d = direct_once()
+        rec['compile_direct_s'] = round(time.time() - t0, 4)
+        fft_s, direct_s = [], []
+        for _ in range(reps):
+            t0 = time.time()
+            fft_once()
+            fft_s.append(time.time() - t0)
+            t0 = time.time()
+            direct_once()
+            direct_s.append(time.time() - t0)
+        rec['fft_s'] = round(min(fft_s), 5)
+        rec['direct_s'] = round(min(direct_s), 5)
+        rec['crossover'] = {
+            'fft_s': rec['fft_s'], 'direct_s': rec['direct_s'],
+            'speedup_fft_over_direct': round(
+                rec['direct_s'] / max(rec['fft_s'], 1e-9), 3),
+            'faster': 'fft' if rec['fft_s'] <= rec['direct_s']
+                      else 'direct'}
+
+        # cross-path agreement: valid whenever no triangle can wrap
+        overlap = 2 * (nbins + 1) <= nmesh // 2
+        rec['closure_overlap'] = bool(overlap)
+        if overlap:
+            both = ~(np.isnan(Bf) | np.isnan(Bd))
+            ntri_ok = bool(np.array_equal(
+                np.nan_to_num(ntri_f, nan=-1.0),
+                np.nan_to_num(ntri_d, nan=-1.0)))
+            bscale = float(np.abs(Bd[both]).max()) if both.any() \
+                else 1.0
+            b_max_rel = float(np.abs(Bf[both] - Bd[both]).max()
+                              / max(bscale, 1e-300)) if both.any() \
+                else 0.0
+            rec['agreement'] = {'ntri_bit_identical': ntri_ok,
+                                'b_max_rel': round(b_max_rel, 6),
+                                'b_scale': bscale,
+                                'cells_compared': int(both.sum())}
+            rec['agree_ok'] = bool(ntri_ok and b_max_rel < 0.1)
+        plan_f = memory_plan(nmesh, npart, ndevices=nproc,
+                             workload='bispectrum', nbins=nbins,
+                             bspec_method='fft')
+        plan_d = memory_plan(nmesh, npart, ndevices=nproc,
+                             workload='bispectrum', nbins=nbins,
+                             bspec_method='direct',
+                             pairblock_tile=tile)
+        rec['plan_fft_peak_bytes'] = int(plan_f['peak_bytes'])
+        rec['plan_direct_peak_bytes'] = int(plan_d['peak_bytes'])
+        rec['tuned'] = tuned_snapshot(nmesh=nmesh, npart=npart,
+                                      nproc=nproc)
+        rec['value'] = min(rec['fft_s'], rec['direct_s'])
+    return _stamp(rec)
+
+
 def run_integrity(nmesh=64, npart=200000, reps=3, seed=7):
     """The data-integrity round (docs/INTEGRITY.md): price the tier-0
     guards and prove the detect -> retry -> deliver loop end to end.
@@ -2475,6 +2609,13 @@ if __name__ == '__main__':
             int(argv[1]) if argv[1:] else 32,
             npart=int(argv[2]) if argv[2:] else None,
             steps=int(argv[3]) if argv[3:] else 2,
+            seed=int(argv[4]) if argv[4:] else 0)))
+        sys.exit(0)
+    if argv[0] == '--bispectrum':
+        print(json.dumps(run_bispectrum(
+            int(argv[1]) if argv[1:] else 32,
+            npart=int(argv[2]) if argv[2:] else 20000,
+            nbins=int(argv[3]) if argv[3:] else 3,
             seed=int(argv[4]) if argv[4:] else 0)))
         sys.exit(0)
     print("unknown args: %r" % (argv,), file=sys.stderr)

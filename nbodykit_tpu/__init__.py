@@ -148,6 +148,15 @@ _default_options = {
     'data_steal_grace_s': 'auto',
     # live telemetry export (nbodykit_tpu.diagnostics.export,
     # docs/OBSERVABILITY.md): an integer TCP port starts a
+    # bispectrum estimator selection: 'fft' (Scoccimarro filtered-field
+    # triangle counts, low k), 'direct' (blocked pairwise mode sums on
+    # the MXU, high k), or 'auto' — consult the tune cache for the
+    # measured crossover of this platform/shape, falling back to 'fft'
+    'bspec_method': 'auto',
+    # tile edge of the direct path's dense (tile x tile) phase blocks
+    # (ops/pairblock.py). 'auto' consults the tune cache (raced inside
+    # the bspec space), falling back to 1024
+    'pairblock_tile': 'auto',
     # zero-dependency background HTTP thread serving the metrics
     # registry and SLO state as Prometheus text (/metrics), JSON
     # snapshots (/metrics.json, /slo) and the flight-recorder ring

@@ -5,7 +5,8 @@ from .fftpower import FFTPower, ProjectedFFTPower, FFTBase, project_to_basis
 from .fftcorr import FFTCorr
 from .convpower import ConvolvedFFTPower, FKPCatalog, FKPWeightFromNbar
 from .fftrecon import FFTRecon
+from .bispectrum import Bispectrum
 
 __all__ = ['FFTPower', 'ProjectedFFTPower', 'FFTBase', 'FFTCorr',
            'ConvolvedFFTPower', 'FKPCatalog', 'FKPWeightFromNbar', 'FFTRecon',
-           'project_to_basis']
+           'Bispectrum', 'project_to_basis']

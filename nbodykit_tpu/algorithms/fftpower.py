@@ -152,13 +152,10 @@ def project_to_basis(y3d, edges, los=[0, 0, 1], poles=[]):
                 jnp.int32).reshape(1, 1, N2)
         x2fac = [ix * ix, iy * iy, iz * iz]  # int32, exact
         # integer edge thresholds: for integer v, (e <= v) == (ceil(e)
-        # <= v), so digitizing int32 |i|^2 against ceil'd edges is
-        # FULLY exact — casting the f64 edges to f32 instead would let
-        # an edge within one ulp of an integer collapse onto the
-        # lattice and flip that boundary mode vs the f64 path
-        qe = np.ceil((np.asarray(xedges, dtype='f8') / unit) ** 2)
-        x2edges = jnp.asarray(
-            np.clip(qe, 0, np.iinfo(np.int32).max).astype('i4'))
+        # <= v), so digitizing int32 |i|^2 against the ceil'd edges is
+        # FULLY exact — see ops.histogram.lattice_shell_edges
+        from ..ops.histogram import lattice_shell_edges
+        x2edges = jnp.asarray(lattice_shell_edges(xedges, unit))
     else:
         unit = 1.0
         x2edges = jnp.asarray(np.asarray(xedges, dtype='f8') ** 2)

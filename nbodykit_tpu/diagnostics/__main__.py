@@ -825,6 +825,52 @@ def run_doctor(trace=None, root='.', self_check_only=False,
                 lines.append('forward      OK: %s' % desc)
 
     if root is not None:
+        # bispectrum posture: the latest committed bispectrum round
+        # (bench.py --bispectrum, docs/BISPECTRUM.md).  The hard
+        # failure is cross-path disagreement in the overlap band —
+        # the FFT and direct estimators measure the SAME statistic
+        # wherever no triangle can alias, so differing triangle
+        # counts or divergent B means one estimator is wrong.
+        from .regress import bispectrum_summary
+        bsp = bispectrum_summary(root)
+        if bsp is None:
+            lines.append('bispectrum   SKIP: no bispectrum record in '
+                         'any committed bench round')
+        elif 'error' in bsp:
+            warn.append('bispectrum')
+            lines.append('bispectrum   WARN: bispectrum summary '
+                         'unavailable (%s)' % bsp['error'])
+        else:
+            desc = ('mesh%s/part%s x%s shells; fft %ss vs direct %ss '
+                    '(%s faster at this shape, tile %s)'
+                    % (bsp.get('nmesh', '?'), bsp.get('npart', '?'),
+                       bsp.get('nbins', '?'), bsp.get('fft_s', '?'),
+                       bsp.get('direct_s', '?'),
+                       bsp.get('faster', '?'),
+                       bsp.get('pairblock_tile', '?')))
+            if bsp.get('closure_overlap') and (
+                    bsp.get('ntri_bit_identical') is False
+                    or bsp.get('agree_ok') is False):
+                fail.append('bispectrum')
+                lines.append('bispectrum   FAIL: the FFT and direct '
+                             'estimators DISAGREE in the closure '
+                             'overlap (ntri identical: %s, B max rel '
+                             '%s) — one of them is wrong (%s)'
+                             % (bsp.get('ntri_bit_identical', '?'),
+                                bsp.get('b_max_rel', '?'), desc))
+            elif not bsp.get('closure_overlap'):
+                warn.append('bispectrum')
+                lines.append('bispectrum   WARN: measured shape has '
+                             'no alias-free closure overlap — the '
+                             'cross-path agreement went unchecked '
+                             '(%s)' % desc)
+            else:
+                lines.append('bispectrum   OK: agreement max rel %s '
+                             'over %s shells — %s'
+                             % (bsp.get('b_max_rel', '?'),
+                                bsp.get('nbins', '?'), desc))
+
+    if root is not None:
         # integrity posture: tripwire violations caught vs retried
         # clean, the shadow-verification ledger, and quarantined
         # ranks.  The ONE hard failure is an unacknowledged shadow

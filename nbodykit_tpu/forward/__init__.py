@@ -22,8 +22,8 @@ See docs/FORWARD.md for the stepper math and the adjoint contract.
 from .lpt import (linear_amplitude, linear_modes, modes_from_white,
                   lpt_displacements, lpt_init)
 from .adjoint import resolve_forward_paint, make_paint
-from .pm import (ForwardModel, dkick, ddrift, power_law,
-                 normalized_amplitude)
+from .pm import (ForwardModel, GrowthTable, dkick, ddrift,
+                 power_law, normalized_amplitude)
 from .infer import (binned_power, cross_correlation,
                     mean_cross_correlation, make_loss, linear_init,
                     recover, fftrecon_baseline)
@@ -32,7 +32,7 @@ __all__ = [
     'linear_amplitude', 'linear_modes', 'modes_from_white',
     'lpt_displacements', 'lpt_init',
     'resolve_forward_paint', 'make_paint',
-    'ForwardModel', 'dkick', 'ddrift', 'power_law',
+    'ForwardModel', 'GrowthTable', 'dkick', 'ddrift', 'power_law',
     'normalized_amplitude',
     'binned_power', 'cross_correlation', 'mean_cross_correlation',
     'make_loss', 'linear_init', 'recover', 'fftrecon_baseline',

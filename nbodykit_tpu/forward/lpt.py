@@ -107,7 +107,7 @@ def lpt_displacements(pm, delta_k, order=2):
     return psi1, psi2
 
 
-def lpt_init(pm, delta_k, a=0.1, order=2):
+def lpt_init(pm, delta_k, a=0.1, order=2, growth=None):
     """Particle (positions, momenta) at scale factor ``a`` from linear
     modes, one particle per mesh cell (box units).
 
@@ -115,11 +115,33 @@ def lpt_init(pm, delta_k, a=0.1, order=2):
     x-fastest raster order matches ``field.reshape(-1)``, so the
     displacement at each particle is a reshape of the displacement
     field — exact and trivially differentiable.
+
+    ``growth`` is None (the EdS closed forms above, bit-for-bit) or a
+    :class:`~.pm.GrowthTable`, generalizing to a LCDM background:
+
+      x = q + D1 psi1 + D2 psi2
+      p = a^2 E(a) (f1 D1 psi1 + f2 D2 psi2)
+
+    (EdS ``D1 = a, f1 = 1, D2 = -(3/7) a^2, f2 = 2, E = a^{-3/2}``
+    recovers the hardcoded factors).
     """
     psi1, psi2 = lpt_displacements(pm, delta_k, order=order)
     cdt = jnp.dtype(pm.compute_dtype)
     q = pm.generate_uniform_particle_grid(shift=0.0, dtype=cdt)
     d1 = jnp.stack([p.reshape(-1).astype(cdt) for p in psi1], axis=-1)
+    if growth is not None:
+        af = float(a)
+        D1, f1 = growth.D1(af), growth.f1(af)
+        pre = af ** 2 * growth.E(af)
+        pos = q + D1 * d1
+        mom = pre * f1 * D1 * d1
+        if psi2 is not None:
+            d2 = jnp.stack([p.reshape(-1).astype(cdt) for p in psi2],
+                           axis=-1)
+            D2, f2 = growth.D2(af), growth.f2(af)
+            pos = pos + D2 * d2
+            mom = mom + pre * f2 * D2 * d2
+        return pos, mom
     a = jnp.asarray(a, cdt)
     pos = q + a * d1
     mom = a ** 1.5 * d1

@@ -21,6 +21,21 @@ exact solution of the discrete operators at linear order up to the
 O(da^3) midpoint error — the property the 2LPT-vs-ZA asymptotics test
 leans on.
 
+The same equations hold for a general matter + Lambda background with
+``E(a) = H(a)/H0``: the prefactor integrals become
+
+  dkick(a0, a1)  = int da / (a^2 E(a))
+  ddrift(a0, a1) = int da / (a^3 E(a))
+
+(EdS ``E = a^{-3/2}`` recovers the closed forms above) and the LPT
+initial conditions use the tabulated growth factors D1(a)/D2(a) and
+rates f1/f2 from the :mod:`..cosmology.background` ODE solver instead
+of the EdS ``D1 = a``, ``D2 = -(3/7) a^2``.  :class:`GrowthTable`
+packages exactly that — solved once at model build, interpolated on a
+host-side table, so the traced program still sees static per-step
+prefactors.  ``ForwardModel(omega_m=1)`` (the default) keeps the EdS
+closed forms bit-for-bit.
+
 ``ForwardModel`` packages lattice + force mesh + tuned grad-safe paint
 (adjoint.make_paint) into the modes -> density map the serve plane
 runs as a ``Forward`` request; ``jax.grad`` through
@@ -45,6 +60,89 @@ def dkick(a0, a1):
 def ddrift(a0, a1):
     """Exact drift prefactor integral int_{a0}^{a1} a^{-3/2} da (EdS)."""
     return 2.0 * (1.0 / np.sqrt(a0) - 1.0 / np.sqrt(a1))
+
+
+# Gauss-Legendre nodes for the LCDM prefactor integrals: the
+# integrands 1/(a^2 E) and 1/(a^3 E) are smooth on any step interval,
+# so 64 points are exact to machine precision
+_GL_X, _GL_W = np.polynomial.legendre.leggauss(64)
+
+
+class GrowthTable:
+    """Tabulated LCDM growth for the forward stepper.
+
+    Solves the first- and second-order growth ODEs once
+    (:class:`~nbodykit_tpu.cosmology.background.MatterDominated`,
+    matter + Lambda + curvature, radiation ignored) and rescales the
+    solution to the *early-time gauge* the stepper and LPT use:
+    ``D1(a) -> a`` as ``a -> 0`` (so EdS reduces to ``D1 = a``,
+    ``D2 = -(3/7) a^2`` identically, and ``D1(a=1) ~= 0.779`` for
+    ``Omega0_m = 0.3`` — the growth suppression a Lambda background
+    pays relative to EdS).
+
+    All evaluations are host-side floats interpolated in ``log a`` on
+    a dense table — the KDK schedule is static under jit, so per-step
+    growth factors enter the traced program as constants, exactly like
+    the EdS closed forms they generalize.
+    """
+
+    def __init__(self, omega_m, omega_k=0.0, na=8192):
+        from ..cosmology.background import MatterDominated
+        self.omega_m = float(omega_m)
+        self.omega_k = float(omega_k)
+        P = MatterDominated(self.omega_m, Omega0_k=self.omega_k)
+        # the solver normalizes D1(a_normalize=1) = 1; undo it via the
+        # early-time limit D1_raw(a) -> a (Lambda is negligible at
+        # a = 1e-4 to ~1e-12), restoring the stepper's gauge
+        a_ref = 1e-4
+        scale = a_ref / float(P.D1(a_ref))
+        self._P = P
+        self._lna = np.log(np.geomspace(1e-3, 1.5, int(na)))
+        a = np.exp(self._lna)
+        self._D1 = np.asarray(P.D1(a), dtype='f8') * scale
+        self._f1 = np.asarray(P.f1(a), dtype='f8')
+        self._D2 = np.asarray(P.D2(a), dtype='f8') * scale ** 2
+        self._f2 = np.asarray(P.f2(a), dtype='f8')
+
+    def _interp(self, tab, a):
+        out = np.interp(np.log(np.asarray(a, dtype='f8')),
+                        self._lna, tab)
+        return float(out) if np.ndim(a) == 0 else out
+
+    def D1(self, a):
+        """First-order growth factor (early-time gauge D1 -> a)."""
+        return self._interp(self._D1, a)
+
+    def f1(self, a):
+        """First-order growth rate dlnD1/dlna."""
+        return self._interp(self._f1, a)
+
+    def D2(self, a):
+        """Second-order growth factor (EdS limit -(3/7) a^2)."""
+        return self._interp(self._D2, a)
+
+    def f2(self, a):
+        """Second-order growth rate dlnD2/dlna."""
+        return self._interp(self._f2, a)
+
+    def E(self, a):
+        """Dimensionless Hubble rate H(a)/H0 (closed form)."""
+        out = self._P.efunc(a)
+        return float(out) if np.ndim(a) == 0 else out
+
+    def _quad(self, f, a0, a1):
+        mid, half = 0.5 * (a0 + a1), 0.5 * (a1 - a0)
+        a = mid + half * _GL_X
+        return float(np.sum(_GL_W * f(a)) * half)
+
+    def dkick(self, a0, a1):
+        """Kick prefactor integral int_{a0}^{a1} da / (a^2 E(a))."""
+        return self._quad(lambda a: 1.0 / (a * a * self.E(a)), a0, a1)
+
+    def ddrift(self, a0, a1):
+        """Drift prefactor integral int_{a0}^{a1} da / (a^3 E(a))."""
+        return self._quad(lambda a: 1.0 / (a ** 3 * self.E(a)),
+                          a0, a1)
 
 
 def power_law(A=1.0, n=-2.5):
@@ -117,6 +215,11 @@ class ForwardModel:
         self.order = int(order)
         self.resampler = resampler
         self.omega_m = float(omega_m)
+        # omega_m != 1 switches the stepper to the tabulated LCDM
+        # growth gauge; the default EdS path keeps the closed-form
+        # prefactors bit-for-bit
+        self.growth = None if self.omega_m == 1.0 \
+            else GrowthTable(self.omega_m)
         self.paint_fn, self.paint_cfg = make_paint(
             self.pm, self.npart, resampler)
         if linear_power is not None:
@@ -157,13 +260,21 @@ class ForwardModel:
             pos, resampler=self.resampler) for d in range(3)]
         return jnp.stack(acc, axis=-1)
 
+    def _dkick(self, a0, a1):
+        return dkick(a0, a1) if self.growth is None \
+            else self.growth.dkick(a0, a1)
+
+    def _ddrift(self, a0, a1):
+        return ddrift(a0, a1) if self.growth is None \
+            else self.growth.ddrift(a0, a1)
+
     def kdk_step(self, pos, mom, a0, a1):
         """One kick-drift-kick step from a0 to a1 (geometric midpoint
         for the kick split, matching the exact-integral prefactors)."""
         ah = np.sqrt(a0 * a1)
-        mom = mom + self.gravity(pos) * dkick(a0, ah)
-        pos = pos + mom * ddrift(a0, a1)
-        mom = mom + self.gravity(pos) * dkick(ah, a1)
+        mom = mom + self.gravity(pos) * self._dkick(a0, ah)
+        pos = pos + mom * self._ddrift(a0, a1)
+        mom = mom + self.gravity(pos) * self._dkick(ah, a1)
         return pos, mom
 
     def evolve(self, modes):
@@ -171,7 +282,7 @@ class ForwardModel:
         LPT ICs at ``a_start`` then ``pm_steps`` KDK steps.  Pure in
         ``modes``; the step schedule is static (unrolled under jit)."""
         pos, mom = lpt_init(self.lattice, modes, a=self.a_start,
-                            order=self.order)
+                            order=self.order, growth=self.growth)
         aa = np.linspace(self.a_start, self.a_end, self.pm_steps + 1)
         for a0, a1 in zip(aa[:-1], aa[1:]):
             pos, mom = self.kdk_step(pos, mom, float(a0), float(a1))

@@ -13,7 +13,7 @@ from .base.mesh import MeshSource, FieldMesh  # noqa: F401
 from .source.catalog import ArrayCatalog, RandomCatalog, UniformCatalog  # noqa: F401
 from .source.mesh import CatalogMesh, LinearMesh, ArrayMesh  # noqa: F401
 from .algorithms import (FFTPower, ProjectedFFTPower, FFTCorr,  # noqa: F401
-                         FFTBase, project_to_basis)
+                         FFTBase, Bispectrum, project_to_basis)
 from . import transform  # noqa: F401
 from .source.catalog import LogNormalCatalog  # noqa: F401,E402
 from . import cosmology  # noqa: F401,E402

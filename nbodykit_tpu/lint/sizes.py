@@ -74,6 +74,9 @@ PRODUCER_TAILS = frozenset({
     'fftn_c2c_single_lowmem',
     'generate_whitenoise', 'to_real_field', 'to_complex_field',
     'rfftn', 'irfftn', 'fftn', 'ifftn',
+    # bispectrum: each per-shell filtered field is a full real mesh
+    # (mask in k, one c2r out — algorithms/bispectrum.py)
+    'shell_filtered_field',
 })
 
 #: producers that take OWNERSHIP of their (boxed) input — the
@@ -108,6 +111,7 @@ _PRODUCER_INTERNAL = {
     'dist_rfftn': 3.0, 'dist_irfftn': 3.0, 'dist_fftn_c2c': 3.0,
     'rfftn': 2.0, 'irfftn': 2.0, 'fftn': 2.0, 'ifftn': 2.0,
     'r2c': 3.0, 'c2r': 3.0,
+    'shell_filtered_field': 3.0,
 }
 
 #: allocation tails that are mesh-sized when their shape says so

@@ -94,6 +94,48 @@ def hist2d_mxu(abin, bbin, weights, NA, NB, chunk=131072,
     return out
 
 
+def lattice_shell_index(isq, nbins):
+    """Exact integer-lattice shell index floor(sqrt(isq)), clipped to
+    ``nbins - 1``.
+
+    The shared shell-assignment path of the FFTPower-style unit-width
+    binnings (serve/scheduler.py, bench.py) and the bispectrum k-bin
+    masks: shells are ``[m, m+1)`` in lattice units, so the bin of an
+    integer squared norm ``isq = ix^2 + iy^2 + iz^2`` (or the real-space
+    ``dsq`` analogue) is exactly ``floor(sqrt(isq))``.  A straight f32
+    sqrt rounds modes sitting ON a shell boundary (any perfect-square
+    ``isq``) to a rounding-dependent side; the two integer compares
+    below correct the rounded root exactly — one rsqrt + two compares
+    per element instead of a searchsorted binary search.
+
+    ``isq`` must be int32 with ``(r+1)^2`` inside int32 — true for any
+    admissible mesh (3*(Nmesh/2+1)^2 ~ 1.3e7 at Nmesh=4096).
+    """
+    isq = isq.astype(jnp.int32)
+    r = jnp.sqrt(isq.astype(jnp.float32)).astype(jnp.int32)
+    # exact floor correction of the f32 sqrt rounding
+    # nbkl: disable=NBK704
+    r = r - (r * r > isq) + ((r + 1) * (r + 1) <= isq)
+    return jnp.minimum(r, nbins - 1)
+
+
+def lattice_shell_edges(xedges, unit):
+    """Integer squared-norm thresholds for digitizing int32 ``|i|^2``
+    against physical bin edges ``xedges`` on a uniform lattice of
+    fundamental ``unit``.
+
+    For integer ``v``, ``(e <= v) == (ceil(e) <= v)``, so digitizing
+    the exact int32 lattice norms against the ceil'd squared edges is
+    FULLY edge-exact — casting the f64 edges to f32 instead would let
+    an edge within one ulp of an integer collapse onto the lattice and
+    flip that boundary mode (the exact-integer story of
+    algorithms/fftpower.py's no-x64 binning path).  Returns an int32
+    numpy array of ``len(xedges)`` thresholds.
+    """
+    qe = np.ceil((np.asarray(xedges, dtype='f8') / float(unit)) ** 2)
+    return np.clip(qe, 0, np.iinfo(np.int32).max).astype('i4')
+
+
 def hist2d_bincount(abin, bbin, weights, NA, NB):
     """Exact scatter-add path (fast on CPU, exact in the weights'
     dtype)."""

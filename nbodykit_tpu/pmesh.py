@@ -768,7 +768,8 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
                 exchange_imbalance=1.5, fft_decomp='slab',
                 fft_pencil=None, ingest_chunk_rows=None,
                 catalog_bytes=None, workload='fftpower',
-                pm_steps=None):
+                pm_steps=None, nbins=None, bspec_method='fft',
+                pairblock_tile=None):
     """Estimated peak per-device HBM for the FFTPower pipeline
     (paint -> rFFT -> |delta_k|^2 -> chunked binning) — the arithmetic
     behind chunk-size choices and the BASELINE.md scale claims
@@ -822,6 +823,21 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     report carries ``forward_state_bytes`` / ``grad_residual_bytes``
     / ``workload`` / ``pm_steps`` so an admission rejection can quote
     exactly which term broke the budget.
+
+    ``workload='bispectrum'`` prices the hybrid higher-order estimator
+    (nbodykit_tpu.algorithms.bispectrum, docs/BISPECTRUM.md).  The
+    FFT path streams per-shell filtered fields through one compiled
+    triple-product program, so its peak holds exactly THREE real
+    fields next to the complex spectrum and the transform workspace —
+    ``nbins`` shifts the triangle count, not the residency.  The
+    direct path (``bspec_method='direct'``) holds no mesh at all: its
+    peak is the O(tile^2) dense phase blocks of ops/pairblock
+    (``pairblock_tile``; phases + cos/sin images + the weight GEMV,
+    billed 4 tile^2 compute words erring high on fusion) plus the
+    per-mode accumulators of the ~(4 pi / 3)(nbins+1)^3 lattice modes.
+    The report carries ``workload`` / ``nbins`` / ``bspec_method`` and
+    the dominant term (``shell_fields_bytes`` or ``pairblock_bytes``)
+    so a rejection can quote which estimator broke the budget.
 
     ``ingest_chunk_rows`` prices the streaming-ingestion pipeline of a
     ``data_ref`` request (nbodykit_tpu.ingest): the resident sharded
@@ -973,6 +989,37 @@ def memory_plan(Nmesh, npart, ndevices=1, dtype='f4', resampler='cic',
     peak = max(real + pos_b + paint_tmp + exch + ingest_buf,
                real + cplx + fft_ws + pos_b,
                cplx + p3 + pos_b)
+    if workload == 'bispectrum':
+        nb = max(int(nbins or 4), 1)
+        if bspec_method == 'direct':
+            # no mesh: dense (tile x tile) phase blocks (phase +
+            # cos/sin images + the weight GEMV inputs — 4 tile^2
+            # compute words, erring high on what XLA fuses) plus the
+            # re/im accumulators over the enumerated lattice modes
+            if pairblock_tile is None:
+                from .tune.resolve import effective_int_option
+                pairblock_tile = effective_int_option('pairblock_tile')
+            t = max(int(pairblock_tile), 8)
+            nk = 4.0 * np.pi / 3.0 * float(nb + 1) ** 3
+            pair_b = 4.0 * t * t * citem
+            acc_b = 4.0 * nk * citem
+            peak = pos_b + pair_b + acc_b + exch
+            phases['pairblock_bytes'] = pair_b
+            phases['pairblock_tile'] = t
+        else:
+            # the streaming Scoccimarro triple product: the complex
+            # spectrum stays resident while each triangle's three
+            # shell-filtered REAL fields are c2r'd next to the
+            # transform workspace — 3 real + 1 complex at peak,
+            # independent of nbins (the triangle loop reuses one
+            # compiled program)
+            shell_b = 3 * real
+            peak = max(real + pos_b + paint_tmp + exch + ingest_buf,
+                       cplx + shell_b + fft_ws + pos_b)
+            phases['shell_fields_bytes'] = shell_b
+        phases['workload'] = 'bispectrum'
+        phases['nbins'] = nb
+        phases['bspec_method'] = bspec_method
     if workload == 'forward':
         steps = max(int(pm_steps or 1), 1)
         # KDK particle state: positions + momenta, always live

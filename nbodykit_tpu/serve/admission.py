@@ -94,9 +94,12 @@ def _plan(request, ndevices, hbm_bytes, paint_chunk=None,
                                         nproc=ndevices)
     # a Forward request is a forward+BACKWARD pipeline: price it with
     # the reverse-mode branch (per-step residuals held live) instead
-    # of the one-shot fftpower peak
-    workload = 'forward' if request.algorithm == 'Forward' \
-        else 'fftpower'
+    # of the one-shot fftpower peak; a Bispectrum request is priced by
+    # its streaming 3-field shell peak (the serve path always runs the
+    # FFT estimator — the direct path is a library/tuner concern)
+    workload = {'Forward': 'forward',
+                'Bispectrum': 'bispectrum'}.get(request.algorithm,
+                                                'fftpower')
     return memory_plan(request.nmesh, request.npart,
                        ndevices=ndevices, dtype=request.dtype,
                        resampler=request.resampler,
@@ -105,7 +108,8 @@ def _plan(request, ndevices, hbm_bytes, paint_chunk=None,
                        ingest_chunk_rows=chunk_rows,
                        catalog_bytes=catalog_bytes,
                        workload=workload,
-                       pm_steps=getattr(request, 'pm_steps', None))
+                       pm_steps=getattr(request, 'pm_steps', None),
+                       nbins=getattr(request, 'nbins', None))
 
 
 def catalog_fits_fn(request, ndevices=1, hbm_bytes=16e9):

@@ -51,11 +51,12 @@ def program_label(request):
 
 def _binned_power(pm, c, resampler, npart):
     """Window-compensated, hermitian-weighted |delta_k|^2 binned onto
-    integer-lattice k shells (exact shell assignment — the same
-    integer-sqrt trick as bench.py's (k,mu) binning).  Returns
+    integer-lattice k shells (exact shell assignment via the shared
+    :func:`~nbodykit_tpu.ops.histogram.lattice_shell_index`).  Returns
     (k, P(k), nmodes) with nmesh//2 shells."""
     import jax.numpy as jnp
     import numpy as np
+    from ..ops.histogram import lattice_shell_index
     from ..ops.window import compensation_transfer
 
     nmesh = int(pm.Nmesh[0])
@@ -69,13 +70,7 @@ def _binned_power(pm, c, resampler, npart):
     p3 = p3.at[0, 0, 0].set(0.0)
 
     ix, iy, iz = pm.i_list_complex()
-    isq = ix * ix + iy * iy + iz * iz
-    r = jnp.sqrt(isq.astype(jnp.float32)).astype(jnp.int32)
-    # (r+1)^2 <= 3*(nmesh/2+1)^2, inside int32 for any admissible
-    # mesh (admission caps nmesh well below 5e4)
-    # nbkl: disable=NBK704
-    r = r - (r * r > isq) + ((r + 1) * (r + 1) <= isq)
-    shell = jnp.minimum(r, nbins - 1)
+    shell = lattice_shell_index(ix * ix + iy * iy + iz * iz, nbins)
     wgt = jnp.broadcast_to(pm.hermitian_weights(jnp.float32), p3.shape)
     flat = jnp.broadcast_to(shell, p3.shape).reshape(-1)
     P = jnp.zeros(nbins, jnp.float32).at[flat].add(
@@ -184,6 +179,62 @@ def _build_single(request, pm):
             return (k.astype(jnp.float32), P.astype(jnp.float32),
                     nm.astype(jnp.float32))
 
+    elif request.algorithm == 'Bispectrum':
+        # equilateral B(k, k, k) per unit-width shell via the
+        # streaming Scoccimarro estimator (docs/BISPECTRUM.md): one
+        # shell-filtered field resident at a time, so peak residency
+        # stays under the memory_plan(workload='bispectrum') price.
+        # The triangle-count normalization is seed-independent mesh
+        # geometry — enumerated exactly on the host here and baked
+        # into the program as constants.
+        import numpy as np
+        from ..algorithms.bispectrum import (_shell_edges2,
+                                             shell_filtered_field)
+        nbins = int(request.nbins or 4)
+        nmesh = int(pm.Nmesh[0])
+        edges2, kedges = _shell_edges2(nbins, pm.BoxSize)
+        V = float(np.prod(pm.BoxSize))
+
+        # ordered (q1, q2) pairs in shell b whose mod-N closure
+        # q3 = -(q1 + q2) lands back in shell b — the same aliased
+        # closure the mesh product sums over
+        M = nbins + 1
+        r = np.arange(-M, M + 1)
+        g = np.stack(np.meshgrid(r, r, r, indexing='ij'),
+                     axis=-1).reshape(-1, 3)
+        isq = (g ** 2).sum(axis=1)
+        T = np.zeros(nbins, dtype='f8')
+        for b in range(nbins):
+            qs = g[(isq >= edges2[b, 0]) & (isq < edges2[b, 1])]
+            tot = 0
+            for lo in range(0, qs.shape[0], 2048):
+                q3 = (-(qs[lo:lo + 2048, None, :] + qs[None, :, :])
+                      + nmesh // 2) % nmesh - nmesh // 2
+                s3 = (q3 ** 2).sum(axis=-1)
+                tot += int(((s3 >= edges2[b, 0])
+                            & (s3 < edges2[b, 1])).sum())
+            T[b] = float(tot)
+        # B = V^2 * sum_x(d^3) / (Ntot * ntri); empty shells report 0
+        # (finite, so shadow verification stays bit-comparable)
+        norm = jnp.asarray(
+            np.where(T > 0, V * V / np.where(T > 0, T, 1.0)
+                     / float(pm.Ntot), 0.0), jnp.float32)
+        ntri_c = jnp.asarray(T, jnp.float32)
+        kmid = jnp.asarray(0.5 * (kedges[1:] + kedges[:-1]),
+                           jnp.float32)
+        e2 = [(int(edges2[b, 0]), int(edges2[b, 1]))
+              for b in range(nbins)]
+
+        def single(seed):
+            c = _delta_c(pm, _uniform_pos(seed, npart, L), resampler,
+                         npart)
+            Bs = []
+            for lo2, hi2 in e2:
+                d = shell_filtered_field(pm, c, lo2, hi2)
+                Bs.append(jnp.sum(d * d * d))
+            B = jnp.stack(Bs).astype(jnp.float32) * norm
+            return kmid, B, ntri_c
+
     else:  # FFTCorr: inverse transform of the 3-d power -> xi(r)
         def single(seed):
             import numpy as np
@@ -203,12 +254,9 @@ def _build_single(request, pm):
                               .astype('i4')).reshape(
                       [1 if i != j else -1 for j in range(3)])
                   for i, n in enumerate(int(v) for v in pm.Nmesh)]
+            from ..ops.histogram import lattice_shell_index
             dsq = ax[0] ** 2 + ax[1] ** 2 + ax[2] ** 2
-            r = jnp.sqrt(dsq.astype(jnp.float32)).astype(jnp.int32)
-            # (r+1)^2 <= 3*(nmesh/2+1)^2, inside int32 for any
-            # admissible mesh  # nbkl: disable=NBK704
-            r = r - (r * r > dsq) + ((r + 1) * (r + 1) <= dsq)
-            shell = jnp.minimum(r, nbins - 1)
+            shell = lattice_shell_index(dsq, nbins)
             flat = jnp.broadcast_to(shell, xi3.shape).reshape(-1)
             S = jnp.zeros(nbins, jnp.float32).at[flat].add(
                 xi3.astype(jnp.float32).reshape(-1))

@@ -579,7 +579,8 @@ class AnalysisServer(object):
         # are per-request, and one suspect member must not force a
         # whole batch through a second execution.
         return (self.ndevices == 1
-                and ticket.request.algorithm == 'FFTPower'
+                and ticket.request.algorithm in ('FFTPower',
+                                                 'Bispectrum')
                 and ticket.request.data_ref is None
                 and not ticket.verify
                 and not ticket.decision.options)

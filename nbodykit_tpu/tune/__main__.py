@@ -15,7 +15,8 @@
         Schema-check the committed cache and print its posture
         summary; exit 1 on a malformed file (the smoke gate).
 
-    Options: --ops paint,fft,exchange,ingest · --paint-shapes 64x1e4,128x1e5
+    Options: --ops paint,fft,exchange,ingest,bspec
+    · --paint-shapes 64x1e4,128x1e5
     · --fft-nmesh 64,128 · --pencil PXxPY (fft decomp factorization)
     · --reps N · --cache PATH · --devices N (CPU: force N virtual
     devices and tune on that mesh).
@@ -91,6 +92,15 @@ def _contexts(args, spaces, nproc):
             pairs.append((spaces['ingest'],
                           {'nmesh': nmesh, 'npart': npart,
                            'dtype': 'f4', 'seed': 7}))
+    if 'bspec' in ops:
+        # the FFT/direct bispectrum crossover, one entry per shape
+        # class (the same NMESHxNPART grid as paint: the crossover
+        # moves with both the mesh the FFT path would need and the
+        # particle count the direct path sums over)
+        for nmesh, npart in _parse_paint_shapes(args.paint_shapes):
+            pairs.append((spaces['bspec'],
+                          {'nmesh': nmesh, 'npart': npart,
+                           'nbins': 3, 'dtype': 'f4', 'seed': 7}))
     return pairs
 
 
@@ -98,7 +108,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='nbodykit-tpu-tune', description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument('--ops', default='paint,fft,exchange,ingest',
+    ap.add_argument('--ops', default='paint,fft,exchange,ingest,bspec',
                     help='comma list of ops to tune (default: all)')
     ap.add_argument('--paint-shapes', default='64x1e4,128x1e5',
                     help="paint trial shapes as NMESHxNPART, comma-"

@@ -51,6 +51,8 @@ FALLBACKS = {
     'mesh_dtype': 'f4',            # cold cache: full-width mesh storage
     'a2a_compress': 'none',        # cold cache: uncompressed payloads
     'ingest_chunk_rows': 262144,   # cold cache: the streaming window
+    'bspec_method': 'fft',         # cold cache: the proven estimator
+    'pairblock_tile': 1024,        # direct-path dense block edge
 }
 
 
@@ -288,6 +290,46 @@ def resolve_ingest_chunk_rows(npart=None, nproc=1):
     return max(int(rows), 1)
 
 
+def resolve_bispectrum(nmesh=None, npart=None, dtype='f4', nproc=1):
+    """The effective bispectrum configuration for one call:
+    ``{'bspec_method', 'pairblock_tile', 'source'}`` with every
+    ``'auto'`` replaced by the ``bspec`` cache winner (or the
+    fallback — ``'fft'`` on a cold cache, the zero-trial contract).
+
+    The FFT/direct crossover is a *measured* per-platform property
+    (the direct path's dense pairwise blocks win only where the MXU's
+    FLOP rate beats the FFT's all_to_all wire time — ISSUE 20), so
+    ``'auto'`` asks the cache keyed by the same shape classes the
+    ``bspec`` tune space races.  Explicit (non-``'auto'``) options are
+    never overridden."""
+    method = _current('bspec_method')
+    tile = _current('pairblock_tile')
+    cfg = {'bspec_method': method, 'pairblock_tile': tile,
+           'source': 'explicit'}
+    asked = (method in (None, 'auto')) or (tile in (None, 'auto'))
+    if asked:
+        winner, source = _consult(
+            'bspec', shape_class(nmesh=nmesh, npart=npart), dtype,
+            nproc)
+        cfg['source'] = source
+        if winner:
+            cfg['winner_name'] = winner.get('bspec_method')
+        if method in (None, 'auto'):
+            cfg['bspec_method'] = winner.get(
+                'bspec_method', FALLBACKS['bspec_method'])
+        if tile in (None, 'auto'):
+            cfg['pairblock_tile'] = winner.get(
+                'pairblock_tile', FALLBACKS['pairblock_tile'])
+    # concreteness guarantees
+    if cfg['bspec_method'] not in ('fft', 'direct'):
+        cfg['bspec_method'] = FALLBACKS['bspec_method']
+    if isinstance(cfg['pairblock_tile'], bool) or \
+            not isinstance(cfg['pairblock_tile'], (int, float)):
+        cfg['pairblock_tile'] = FALLBACKS['pairblock_tile']
+    cfg['pairblock_tile'] = max(int(cfg['pairblock_tile']), 8)
+    return cfg
+
+
 def effective_int_option(option):
     """A concrete integer for a possibly-``'auto'`` option — the value
     the resilience ladder halves from
@@ -314,6 +356,8 @@ def tuned_snapshot(nmesh=None, npart=None, dtype='f4', nproc=1):
     from ..parallel.dfft import resolve_decomp
     decomp, pxpy = resolve_decomp(
         nproc, shape=(nmesh,) * 3 if nmesh else None, dtype=dtype)
+    _bspec = resolve_bispectrum(nmesh=nmesh, npart=npart, dtype=dtype,
+                                nproc=nproc)
     return {
         'paint_method': paint['paint_method'],
         'paint_order': paint['paint_order'],
@@ -348,5 +392,11 @@ def tuned_snapshot(nmesh=None, npart=None, dtype='f4', nproc=1):
         'ingest_source': (
             'auto' if _current('ingest_chunk_rows') == 'auto'
             else 'explicit'),
+        # the bispectrum estimator + direct-path tile this measurement
+        # would dispatch with (ISSUE 20: the fft/direct crossover is a
+        # measured per-platform property)
+        'bspec_method': _bspec['bspec_method'],
+        'pairblock_tile': _bspec['pairblock_tile'],
+        'bspec_source': _bspec['source'],
         'cache': TuneCache().path,
     }

@@ -397,7 +397,76 @@ def ingest_space():
                        _ingest_candidates, _ingest_runner)
 
 
+# ---------------------------------------------------------------------------
+# bspec — the FFT/direct bispectrum crossover (ISSUE 20)
+
+def _bspec_candidates(ctx):
+    """The estimator race: the Scoccimarro FFT path against the
+    MXU-shaped direct path at several dense-block tiles.  Which wins
+    is a *per-platform, per-shape* property — the direct path's
+    O(Npart x Nk) FLOPs beat the FFT's wire time only where the MXU
+    can stream them (PAPERS.md 2005.01739) — so the crossover is
+    measured here, never guessed.  Direct tiles are clipped to the
+    trial's particle count (a tile bigger than the catalog pads to
+    waste and measures nothing)."""
+    npart = int(ctx['npart'])
+    cands = [Candidate('fft', {'bspec_method': 'fft'})]
+    for tile in (256, 1024, 4096):
+        if tile >= 4 * npart and len(cands) > 1:
+            break
+        cands.append(Candidate('direct-tile%d' % tile,
+                               {'bspec_method': 'direct',
+                                'pairblock_tile': tile}))
+    return cands
+
+
+def _bspec_runner(ctx):
+    """One bounded bispectrum measurement per candidate: same
+    deterministic uniform catalog, same shell count; the candidate's
+    ``bspec_method`` / ``pairblock_tile`` are read inside the trial
+    through the class's normal resolution path."""
+    from .. import _global_options
+    from ..parallel.runtime import CurrentMesh
+    from ..pmesh import ParticleMesh
+    import numpy as np
+
+    box = float(ctx.get('box', 1000.0))
+    nbins = int(ctx.get('nbins', 3))
+    nmesh = int(ctx.get('nmesh', 64))
+    rng = np.random.RandomState(int(ctx.get('seed', 7)))
+    npart = int(ctx['npart'])
+    pos = rng.uniform(0.0, box, size=(npart, 3))
+    w = np.ones(npart)
+    mesh = CurrentMesh.resolve(None)
+
+    def once():
+        from ..algorithms.bispectrum import (direct_bispectrum,
+                                             fft_bispectrum)
+        method = _global_options['bspec_method']
+        if method == 'direct':
+            tile = _global_options['pairblock_tile']
+            B, _ = direct_bispectrum(
+                pos, w, box, nbins,
+                tile=None if tile in (None, 'auto') else int(tile),
+                comm=mesh)
+        else:
+            import jax.numpy as jnp
+            pm = ParticleMesh(Nmesh=nmesh, BoxSize=box,
+                              dtype=ctx.get('dtype', 'f4'),
+                              comm=mesh)
+            delta = pm.paint(jnp.asarray(pos, pm.dtype), 1.0)
+            B, _ = fft_bispectrum(pm, pm.r2c(delta), nbins)
+        return float(np.nansum(B))
+    return once
+
+
+def bspec_space():
+    return SearchSpace('bspec', ('bspec_method', 'pairblock_tile'),
+                       _bspec_candidates, _bspec_runner)
+
+
 def default_spaces():
     """``{op: SearchSpace}`` of every built-in space."""
     return {'paint': paint_space(), 'fft': fft_space(),
-            'exchange': exchange_space(), 'ingest': ingest_space()}
+            'exchange': exchange_space(), 'ingest': ingest_space(),
+            'bspec': bspec_space()}

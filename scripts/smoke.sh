@@ -192,8 +192,19 @@ assert paints, "no paint trials in the plan"
 for p in paints:
     assert "scatter-bf16" in p["candidates"], (
         "bf16 mesh candidate missing: %r" % p["candidates"])
+# the bispectrum estimator race (docs/BISPECTRUM.md): every bspec
+# trial must pit the FFT path against the direct pairblock tiles —
+# the crossover is measured, never guessed
+bspecs = [p for p in plan if p["op"] == "bspec"]
+assert bspecs, "no bspec trials in the plan"
+for p in bspecs:
+    cands = p["candidates"]
+    assert "fft" in cands, "fft estimator missing: %r" % cands
+    assert any(c.startswith("direct-tile") for c in cands), (
+        "direct pairblock candidates missing: %r" % cands)
 print("tune plan OK: fft candidates " + " ".join(ffts[0]["candidates"])
-      + " @ " + " ".join(p["shape_class"] for p in ffts))
+      + " @ " + " ".join(p["shape_class"] for p in ffts)
+      + "; bspec candidates " + " ".join(bspecs[0]["candidates"]))
 '
 python -m nbodykit_tpu.tune --validate
 
@@ -400,6 +411,74 @@ assert np.isfinite(y).all() and np.abs(y).sum() > 0, y
 assert summary['lost'] == 0, summary
 print('forward serve OK: 1 Forward request completed '
       '(mesh16/n512 x1 step), lost=0')
+EOF
+
+# bispectrum gate (docs/BISPECTRUM.md): the Scoccimarro FFT estimator
+# at mesh 16 must match a brute-force numpy oracle on the equilateral
+# diagonal — every closed (mod-16) within-shell mode triangle summed
+# directly from the full c2c spectrum — with bit-exact triangle
+# counts; then one Bispectrum request rides the serve plane end to
+# end: admitted under the 3-shell-field pricing branch, completed
+# with finite shells, nothing lost
+echo "== bispectrum gate (mesh16 equilateral oracle + serve) =="
+python - <<'EOF'
+from nbodykit_tpu._jax_compat import set_cpu_devices
+set_cpu_devices(8)
+import jax
+jax.config.update('jax_enable_x64', True)
+import numpy as np
+import jax.numpy as jnp
+from nbodykit_tpu.algorithms.bispectrum import fft_bispectrum
+from nbodykit_tpu.pmesh import ParticleMesh
+N, L, nbins = 16, 100.0, 3
+pm = ParticleMesh(Nmesh=N, BoxSize=L, dtype='f8')
+real = np.random.RandomState(5).standard_normal((N, N, N))
+B, ntri = fft_bispectrum(pm, pm.r2c(jnp.asarray(real)), nbins)
+dk = np.fft.fftn(real) / N ** 3
+fx = np.fft.fftfreq(N, 1.0 / N).astype(int)
+qx, qy, qz = np.meshgrid(fx, fx, fx, indexing='ij')
+q = np.stack([qx, qy, qz], -1).reshape(-1, 3)
+isq = (q ** 2).sum(1)
+dflat = dk.reshape(-1)
+for b in range(nbins):
+    lo2, hi2 = (b + 1) ** 2, (b + 2) ** 2
+    qs = q[(isq >= lo2) & (isq < hi2)]
+    ds = dflat[(isq >= lo2) & (isq < hi2)]
+    q3 = (-(qs[:, None, :] + qs[None, :, :])) % N
+    s3 = (((q3 + N // 2) % N - N // 2) ** 2).sum(-1)
+    idx = (q3[..., 0] * N + q3[..., 1]) * N + q3[..., 2]
+    m = (s3 >= lo2) & (s3 < hi2)
+    S = (ds[:, None] * ds[None, :] * dflat[idx])[m].sum()
+    cnt = int(m.sum())
+    assert int(ntri[b, b, b]) == cnt, (b, ntri[b, b, b], cnt)
+    want = L ** 6 * S.real / cnt
+    rel = abs(float(B[b, b, b]) - want) / max(abs(want), 1e-300)
+    assert rel < 1e-6, (b, float(B[b, b, b]), want, rel)
+print('bispectrum oracle OK: mesh16 equilateral, %d shells '
+      'bit-exact ntri, B rel err < 1e-6' % nbins)
+EOF
+python - <<'EOF'
+from nbodykit_tpu._jax_compat import set_cpu_devices
+set_cpu_devices(8)
+import jax
+jax.config.update('jax_enable_x64', True)
+import numpy as np
+from nbodykit_tpu.parallel.runtime import cpu_mesh, use_mesh
+from nbodykit_tpu.serve import COMPLETED, AnalysisRequest, AnalysisServer
+with use_mesh(cpu_mesh(1)):
+    srv = AnalysisServer(per_task=1)
+with srv:
+    r = srv.wait(srv.submit(AnalysisRequest(
+        algorithm='Bispectrum', nmesh=16, npart=4000, nbins=3,
+        seed=9, deadline_s=600.0)), timeout=600)
+    summary = srv.summary()
+assert r.status == COMPLETED, r
+y = np.asarray(r.y)
+assert np.isfinite(y).all() and y.shape == (3,), y
+assert np.asarray(r.nmodes).min() > 0, r.nmodes
+assert summary['lost'] == 0, summary
+print('bispectrum serve OK: 1 Bispectrum request completed '
+      '(mesh16, 3 shells, finite B), lost=0')
 EOF
 
 # region gate (docs/SERVING.md "Region"): a two-fleet router trace
@@ -620,6 +699,7 @@ python -m pytest \
     tests/test_paint_kernels.py \
     tests/test_fftpower.py \
     tests/test_forward.py \
+    tests/test_bispectrum.py \
     tests/test_counted_exchange.py \
     tests/test_radix.py \
     tests/test_ingest.py \

@@ -161,6 +161,52 @@ def test_2lpt_correction_scales_linearly_vs_za():
     assert r2 / r1 == pytest.approx(2.0, rel=1e-10)
 
 
+def test_growth_table_pins_lcdm_d1_and_eds_limit():
+    """GrowthTable in the stepper's early-time gauge (D1 -> a):
+    Omega_m=0.3 pays the textbook Lambda growth suppression at a=1,
+    and Omega_m=1 reproduces the EdS closed forms."""
+    from nbodykit_tpu.forward import GrowthTable, dkick, ddrift
+    g = GrowthTable(0.3)
+    assert g.D1(1.0) == pytest.approx(0.7789, abs=2e-3)
+    assert 0.4 < g.f1(1.0) < 0.6          # ~ Omega_m(a=1)^0.55
+    assert g.D2(1.0) < 0                  # EdS-sign convention
+    e = GrowthTable(1.0)
+    for a in (0.1, 0.33, 0.77, 1.0):
+        assert e.D1(a) == pytest.approx(a, rel=1e-6)
+        assert e.f1(a) == pytest.approx(1.0, abs=1e-5)
+        assert e.D2(a) == pytest.approx(-3.0 / 7 * a * a, rel=1e-4)
+        assert e.f2(a) == pytest.approx(2.0, abs=1e-4)
+    for a0, a1 in ((0.1, 0.4), (0.5, 1.0)):
+        assert e.dkick(a0, a1) == pytest.approx(dkick(a0, a1),
+                                                rel=1e-12)
+        assert e.ddrift(a0, a1) == pytest.approx(ddrift(a0, a1),
+                                                 rel=1e-12)
+
+
+@requires_x64
+def test_lcdm_stepper_suppresses_growth_like_the_table():
+    """Evolving the same tiny ZA displacement through the EdS and
+    Omega_m=0.3 steppers: the ratio of the two growth factors must
+    match D1_lcdm/D1_eds from the table (the mesh's CIC force
+    softening cancels in the ratio to first order)."""
+    def growth_ratio(omega_m):
+        m = ForwardModel(8, pm_steps=8, order=1, omega_m=omega_m,
+                         delta_rms=1e-4, dtype='f8', a_start=0.1)
+        modes = m.linear_modes(3)
+        pos0, _ = lpt_init(m.lattice, modes, a=0.1, order=1,
+                           growth=m.growth)
+        q = m.lattice.generate_uniform_particle_grid(shift=0.0)
+        pos1, _ = m.evolve(modes)
+        d0, d1 = np.asarray(pos0 - q), np.asarray(pos1 - q)
+        return float(np.sum(d0 * d1) / np.sum(d0 * d0))
+
+    from nbodykit_tpu.forward import GrowthTable
+    g = GrowthTable(0.3)
+    want = (g.D1(1.0) / g.D1(0.1)) / (1.0 / 0.1)
+    got = growth_ratio(0.3) / growth_ratio(1.0)
+    assert got == pytest.approx(want, rel=0.05)
+
+
 def test_forward_replay_bit_identical():
     """Same modes -> same density, bit for bit (the contract shadow
     verification and result memoization stand on)."""

@@ -320,6 +320,44 @@ def test_nbk503_silent_without_config_and_under_budget():
     assert lint_str(src, select=['NBK503'], memory_config=small) == []
 
 
+def test_nbk503_shell_filtered_fields_are_mesh_taint():
+    """ISSUE 20 satellite: each per-shell filtered field of the
+    bispectrum estimator (algorithms/bispectrum.py) is a full real
+    mesh, so ``shell_filtered_field`` must be a recognized producer.
+    The fixture pair: the streaming triple-product (3 shell fields
+    live — the memory_plan(workload='bispectrum') contract) FITS the
+    declared budget; naively holding a field per shell EXCEEDS it —
+    if the producer classification regresses, the second assertion
+    catches the silent under-report."""
+    src = """
+    import jax.numpy as jnp
+
+    def triple_streams(pm, cplx):
+        d1 = shell_filtered_field(pm, cplx, 1, 4)
+        d2 = shell_filtered_field(pm, cplx, 4, 9)
+        d3 = shell_filtered_field(pm, cplx, 9, 16)
+        return (d1 * d2 * d3).sum()
+
+    def shells_exceed(pm, cplx):
+        d0 = shell_filtered_field(pm, cplx, 1, 4)
+        d1 = shell_filtered_field(pm, cplx, 4, 9)
+        d2 = shell_filtered_field(pm, cplx, 9, 16)
+        d3 = shell_filtered_field(pm, cplx, 16, 25)
+        d4 = shell_filtered_field(pm, cplx, 25, 36)
+        d5 = shell_filtered_field(pm, cplx, 36, 49)
+        return (d0 * d1 * d2 * d3 * d4 * d5).sum()
+    """
+    # 1 unit = 4.29 GB; budget 0.85*28 GB = 23.8 GB: the streaming
+    # triple (2 live + 3 internal = 5 units = 21.5 GB) fits, the
+    # per-shell pile-up (5 live + 3 internal = 8 units = 34.4 GB)
+    # does not
+    config = lint.make_config(1024, dtype_bytes=4, hbm_bytes=28e9)
+    fs = lint_str(src, select=['NBK503'], memory_config=config)
+    assert codes(fs) == ['NBK503']
+    assert 'shells_exceed' in fs[0].message
+    assert 'triple_streams' not in ' '.join(f.message for f in fs)
+
+
 def test_nbk503_grad_call_site_prices_the_backward_pass():
     """ISSUE 19 satellite: ``jax.grad(f)`` holds f's intermediates as
     residuals for the backward pass, so a grad call site must add f's
